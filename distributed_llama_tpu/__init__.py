@@ -1,0 +1,10 @@
+"""distributed_llama_tpu — TPU-native distributed LLM inference framework.
+
+A ground-up rebuild of the capabilities of `distributed-llama` (C++/TCP tensor-parallel
+CPU inference) as a single-program SPMD JAX/XLA system on TPU meshes. See SURVEY.md for
+the reference blueprint and the mapping from its layers to this package.
+"""
+
+__version__ = "0.1.0"
+
+from .quants import FloatType, QTensor  # noqa: F401

@@ -1,0 +1,267 @@
+"""Block quantization formats (Q40 / Q80), TPU-native layout.
+
+Byte-compatible with the reference `.m` tensor encoding (reference: src/quants.hpp:17-25,
+src/quants.cpp:137-288, converter/writer.py:29-74) but stored on device as *planar* arrays
+instead of 18/34-byte interleaved structs:
+
+    Q40 tensor of shape (rows, n):  packed uint8 (rows, n//32, 16)  + scales f16 (rows, n//32)
+    Q80 tensor of shape (rows, n):  values int8  (rows, n//32, 32)  + scales f16 (rows, n//32)
+
+Planar layout is what TPU wants: the packed nibbles land in HBM as a dense uint8 array that
+Pallas kernels / XLA can tile onto (32, 128)-shaped int8 registers, while the f16 scales form
+a small separate array that broadcasts over each 32-element block. The interleaved struct
+layout of the reference exists only at file I/O boundaries (`*_to_bytes` / `*_from_bytes`).
+
+Nibble semantics match the reference exactly (src/quants.cpp:178-182): byte j of a block
+holds element j in its low nibble and element j+16 in its high nibble; value = (nibble-8)*d.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+QK = 32  # block size for both Q40 and Q80 (reference: src/quants.hpp:14-15)
+Q40_BLOCK_BYTES = 18  # f16 delta + 16 nibble-pair bytes
+Q80_BLOCK_BYTES = 34  # f16 delta + 32 int8
+
+_Q40_STRUCT = np.dtype([("d", "<f2"), ("qs", "u1", (QK // 2,))])
+_Q80_STRUCT = np.dtype([("d", "<f2"), ("qs", "i1", (QK,))])
+
+
+class FloatType(enum.IntEnum):
+    """Wire/storage float types (reference: src/quants.hpp:6-12)."""
+
+    F32 = 0
+    F16 = 1
+    Q40 = 2
+    Q80 = 3
+
+
+def batch_bytes(ftype: FloatType, n: int, d: int = 1) -> int:
+    """Bytes for a (d, n) tensor in the given storage type (reference: src/quants.cpp:28-51)."""
+    count = n * d
+    if ftype == FloatType.F32:
+        return count * 4
+    if ftype == FloatType.F16:
+        return count * 2
+    if ftype == FloatType.Q40:
+        assert n % QK == 0, (n, d)
+        return (count // QK) * Q40_BLOCK_BYTES
+    if ftype == FloatType.Q80:
+        assert n % QK == 0, (n, d)
+        return (count // QK) * Q80_BLOCK_BYTES
+    raise ValueError(f"unknown float type {ftype}")
+
+
+# ---------------------------------------------------------------------------
+# Q40: 4-bit blocks, asymmetric-ish (min/max) scaling with +8.5 offset
+# ---------------------------------------------------------------------------
+
+
+def quantize_q40(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Quantize float array (..., n) to Q40 planar (packed, scales).
+
+    Matches converter/writer.py:29-53: delta = extremum/-8 in f16, q = clip(x/delta+8.5, 0, 15).
+
+    Returns (packed uint8 (..., n//32, 16), scales float16 (..., n//32)).
+    """
+    x = np.asarray(x, dtype=np.float32)
+    n = x.shape[-1]
+    assert n % QK == 0, n
+    g = x.reshape(*x.shape[:-1], n // QK, QK)
+    gmax = g.max(axis=-1)
+    gmin = g.min(axis=-1)
+    deltas = np.where(-gmin > gmax, gmin, gmax) / -8.0
+    deltas16 = deltas.astype(np.float16)
+    inv = np.divide(1.0, deltas, out=np.zeros_like(deltas), where=deltas != 0).astype(np.float32)
+    q = np.clip(g * inv[..., None] + 8.5, 0, 15).astype(np.uint8)
+    packed = q[..., : QK // 2] | (q[..., QK // 2 :] << 4)
+    return packed, deltas16
+
+
+def dequantize_q40(packed: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Planar Q40 -> float32 (..., n). Matches src/quants.cpp:170-183."""
+    lo = (packed & 0x0F).astype(np.int8) - 8
+    hi = (packed >> 4).astype(np.int8) - 8
+    vals = np.concatenate([lo, hi], axis=-1).astype(np.float32)
+    out = vals * scales[..., None].astype(np.float32)
+    return out.reshape(*packed.shape[:-2], packed.shape[-2] * QK)
+
+
+def q40_to_bytes(packed: np.ndarray, scales: np.ndarray) -> bytes:
+    """Planar Q40 -> reference interleaved block stream (BlockQ40[])."""
+    nb = int(np.prod(packed.shape[:-1]))
+    out = np.empty(nb, dtype=_Q40_STRUCT)
+    out["d"] = scales.reshape(nb)
+    out["qs"] = packed.reshape(nb, QK // 2)
+    return out.tobytes()
+
+
+def q40_from_bytes(buf: bytes, shape: tuple[int, ...]) -> tuple[np.ndarray, np.ndarray]:
+    """Reference BlockQ40[] stream -> planar (packed, scales) for logical shape (..., n)."""
+    n = shape[-1]
+    assert n % QK == 0, shape
+    nb_shape = (*shape[:-1], n // QK)
+    nb = int(np.prod(nb_shape))
+    arr = np.frombuffer(buf, dtype=_Q40_STRUCT, count=nb)
+    return arr["qs"].reshape(*nb_shape, QK // 2).copy(), arr["d"].reshape(nb_shape).copy()
+
+
+# ---------------------------------------------------------------------------
+# Q80: int8 blocks, symmetric absmax/127 scaling
+# ---------------------------------------------------------------------------
+
+
+def quantize_q80(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Quantize (..., n) to Q80 planar (values int8 (..., n//32, 32), scales f16 (..., n//32)).
+
+    Matches converter/writer.py:55-74 / src/quants.cpp:186-268 (round-to-nearest-even).
+    """
+    x = np.asarray(x, dtype=np.float32)
+    n = x.shape[-1]
+    assert n % QK == 0, n
+    g = x.reshape(*x.shape[:-1], n // QK, QK)
+    absmax = np.abs(g).max(axis=-1)
+    deltas = absmax / 127.0
+    deltas16 = deltas.astype(np.float16)
+    inv = np.divide(1.0, deltas, out=np.zeros_like(deltas), where=deltas != 0).astype(np.float32)
+    q = np.round(g * inv[..., None]).astype(np.int8)
+    return q, deltas16
+
+
+def dequantize_q80(values: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    out = values.astype(np.float32) * scales[..., None].astype(np.float32)
+    return out.reshape(*values.shape[:-2], values.shape[-2] * QK)
+
+
+def q80_to_bytes(values: np.ndarray, scales: np.ndarray) -> bytes:
+    nb = int(np.prod(values.shape[:-1]))
+    out = np.empty(nb, dtype=_Q80_STRUCT)
+    out["d"] = scales.reshape(nb)
+    out["qs"] = values.reshape(nb, QK)
+    return out.tobytes()
+
+
+def q80_from_bytes(buf: bytes, shape: tuple[int, ...]) -> tuple[np.ndarray, np.ndarray]:
+    n = shape[-1]
+    assert n % QK == 0, shape
+    nb_shape = (*shape[:-1], n // QK)
+    nb = int(np.prod(nb_shape))
+    arr = np.frombuffer(buf, dtype=_Q80_STRUCT, count=nb)
+    return arr["qs"].reshape(*nb_shape, QK).copy(), arr["d"].reshape(nb_shape).copy()
+
+
+# ---------------------------------------------------------------------------
+# On-device (jnp) dequantization — the XLA-path used outside Pallas kernels
+# ---------------------------------------------------------------------------
+
+
+def jnp_dequantize_q40(packed: jax.Array, scales: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    """Dequantize planar Q40 on device: (..., nb, 16) u8 + (..., nb) f16 -> (..., nb*32)."""
+    lo = (packed & 0x0F).astype(jnp.int8) - 8
+    hi = (packed >> 4).astype(jnp.int8) - 8
+    vals = jnp.concatenate([lo, hi], axis=-1).astype(dtype)
+    out = vals * scales[..., None].astype(dtype)
+    return out.reshape(*packed.shape[:-2], packed.shape[-2] * QK)
+
+
+def jnp_dequantize_q80(values: jax.Array, scales: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    out = values.astype(dtype) * scales[..., None].astype(dtype)
+    return out.reshape(*values.shape[:-2], values.shape[-2] * QK)
+
+
+def jnp_quantize_q80(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """On-device Q80 quantization (..., n) -> (int8 (..., nb, 32), f16 scales).
+
+    TPU-native descendant of the reference's wire compression (src/tasks.cpp:96-135):
+    used for int8-compressed collectives instead of socket payloads.
+    """
+    n = x.shape[-1]
+    g = x.reshape(*x.shape[:-1], n // QK, QK).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(g), axis=-1)
+    deltas = (absmax / 127.0).astype(jnp.float16)
+    inv = jnp.where(absmax > 0, 127.0 / absmax, 0.0)
+    q = jnp.round(g * inv[..., None]).astype(jnp.int8)
+    return q, deltas
+
+
+# ---------------------------------------------------------------------------
+# QTensor: a quantized-or-not weight tensor as a pytree
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QTensor:
+    """A weight tensor of logical shape `shape`, stored dense or block-quantized.
+
+    For Q40/Q80 the block axis is the LAST logical axis (the contraction axis `n` of the
+    reference's (d, n) row-major weights; reference blocks run along n — src/commands.cpp:22-39).
+    Registered as a pytree so QTensors flow through jit/scan/shard_map and can carry per-leaf
+    shardings.
+    """
+
+    ftype: FloatType
+    shape: tuple[int, ...]
+    data: jax.Array | np.ndarray  # dense values, Q40 packed u8, or Q80 int8
+    scales: jax.Array | np.ndarray | None = None  # f16 per-block scales for Q40/Q80
+
+    def tree_flatten(self):
+        if self.scales is None:
+            return (self.data,), (self.ftype, self.shape, False)
+        return (self.data, self.scales), (self.ftype, self.shape, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        ftype, shape, has_scales = aux
+        if has_scales:
+            data, scales = children
+        else:
+            (data,) = children
+            scales = None
+        return cls(ftype=ftype, shape=shape, data=data, scales=scales)
+
+    @classmethod
+    def from_float(cls, x: np.ndarray, ftype: FloatType) -> "QTensor":
+        x = np.asarray(x)
+        if ftype == FloatType.F32:
+            return cls(ftype, x.shape, x.astype(np.float32))
+        if ftype == FloatType.F16:
+            return cls(ftype, x.shape, x.astype(np.float16))
+        if ftype == FloatType.Q40:
+            packed, scales = quantize_q40(x)
+            return cls(ftype, x.shape, packed, scales)
+        if ftype == FloatType.Q80:
+            vals, scales = quantize_q80(x)
+            return cls(ftype, x.shape, vals, scales)
+        raise ValueError(ftype)
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        """Materialize logical values on device (jnp path; Pallas kernels bypass this)."""
+        if self.ftype in (FloatType.F32, FloatType.F16):
+            return jnp.asarray(self.data).astype(dtype)
+        if self.ftype == FloatType.Q40:
+            return jnp_dequantize_q40(jnp.asarray(self.data), jnp.asarray(self.scales), dtype)
+        if self.ftype == FloatType.Q80:
+            return jnp_dequantize_q80(jnp.asarray(self.data), jnp.asarray(self.scales), dtype)
+        raise ValueError(self.ftype)
+
+    def to_numpy(self) -> np.ndarray:
+        if self.ftype in (FloatType.F32, FloatType.F16):
+            return np.asarray(self.data, dtype=np.float32)
+        if self.ftype == FloatType.Q40:
+            return dequantize_q40(np.asarray(self.data), np.asarray(self.scales))
+        if self.ftype == FloatType.Q80:
+            return dequantize_q80(np.asarray(self.data), np.asarray(self.scales))
+        raise ValueError(self.ftype)
+
+    def nbytes(self) -> int:
+        n = self.data.nbytes
+        if self.scales is not None:
+            n += self.scales.nbytes
+        return n

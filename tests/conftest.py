@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax import.
+
+The reference project tests multi-node slicing without a cluster (SURVEY.md §4); we improve
+on that with a real 8-device mesh of virtual CPU devices, so TP/SP sharding tests exercise
+actual collectives.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,131 @@
+"""Quantization round-trip + byte-format compatibility tests.
+
+Mirrors the reference test strategy (src/quants-test.cpp: Q80 round-trip error <= 0.0043
+across several lengths) and adds byte-level golden checks against the reference writer
+semantics (converter/writer.py:29-74).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.quants import (
+    QK,
+    FloatType,
+    QTensor,
+    batch_bytes,
+    dequantize_q40,
+    dequantize_q80,
+    jnp_dequantize_q40,
+    jnp_quantize_q80,
+    q40_from_bytes,
+    q40_to_bytes,
+    q80_from_bytes,
+    q80_to_bytes,
+    quantize_q40,
+    quantize_q80,
+)
+
+
+def _xorshift_data(n, seed=123456789):
+    # deterministic pseudorandom floats in [-1, 1), same spirit as funcs-test.cpp:21
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n).astype(np.float32) * 2.0) - 1.0
+
+
+@pytest.mark.parametrize("n", [1024, 768, 2752])
+def test_q80_roundtrip_error(n):
+    x = _xorshift_data(n)
+    vals, scales = quantize_q80(x)
+    y = dequantize_q80(vals, scales)
+    # reference tolerance: 0.0043 (src/quants-test.cpp:7-52)
+    assert np.max(np.abs(x - y)) <= 0.0043
+
+
+@pytest.mark.parametrize("n", [1024, 4096])
+def test_q40_roundtrip_error(n):
+    x = _xorshift_data(n)
+    packed, scales = quantize_q40(x)
+    y = dequantize_q40(packed, scales)
+    # 4-bit with floor(+8.5 offset): max error ~ one delta = absmax/8 -> 0.125 for [-1,1]
+    assert np.max(np.abs(x - y)) <= 0.13
+
+
+def test_q40_bytes_reference_layout():
+    """Byte stream must match the reference writer exactly (converter/writer.py:29-53)."""
+    x = _xorshift_data(QK * 3)
+    packed, scales = quantize_q40(x)
+    buf = q40_to_bytes(packed, scales)
+    assert len(buf) == batch_bytes(FloatType.Q40, QK * 3)
+
+    # independently re-encode block 0 with the reference algorithm
+    g = x[:QK]
+    delta = (g.min() if -g.min() > g.max() else g.max()) / -8.0
+    d16 = np.float16(delta)
+    q = np.clip(g * (1.0 / delta) + 8.5, 0, 15).astype(int)
+    expect = struct.pack("<e16B", d16, *((q[:16] & 0xF) | ((q[16:] & 0xF) << 4)))
+    assert buf[:18] == expect
+
+    packed2, scales2 = q40_from_bytes(buf, (QK * 3,))
+    np.testing.assert_array_equal(packed2, packed)
+    np.testing.assert_array_equal(scales2, scales)
+
+
+def test_q80_bytes_roundtrip():
+    x = _xorshift_data(QK * 5).reshape(5, QK)  # 2-D tensor (rows, n)
+    vals, scales = quantize_q80(x)
+    buf = q80_to_bytes(vals, scales)
+    assert len(buf) == batch_bytes(FloatType.Q80, QK, 5)
+    vals2, scales2 = q80_from_bytes(buf, (5, QK))
+    np.testing.assert_array_equal(vals2, vals)
+    np.testing.assert_array_equal(scales2, scales)
+
+
+def test_batch_bytes():
+    # reference getBatchBytes (src/quants.cpp:28-51)
+    assert batch_bytes(FloatType.F32, 32, 2) == 256
+    assert batch_bytes(FloatType.F16, 32, 2) == 128
+    assert batch_bytes(FloatType.Q40, 32, 2) == 36
+    assert batch_bytes(FloatType.Q80, 32, 2) == 68
+
+
+def test_jnp_dequant_matches_numpy():
+    import jax.numpy as jnp
+
+    x = _xorshift_data(2 * 256).reshape(2, 256)
+    packed, scales = quantize_q40(x)
+    ref = dequantize_q40(packed, scales)
+    dev = jnp_dequantize_q40(jnp.asarray(packed), jnp.asarray(scales), dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(dev), ref, atol=1e-6)
+
+
+def test_jnp_quantize_q80_matches_numpy():
+    import jax.numpy as jnp
+
+    x = _xorshift_data(512)
+    vals_np, scales_np = quantize_q80(x)
+    vals_j, scales_j = jnp_quantize_q80(jnp.asarray(x))
+    # scales match exactly; int8 values may differ by 1 ulp at rounding boundaries
+    np.testing.assert_array_equal(np.asarray(scales_j), scales_np)
+    assert np.max(np.abs(np.asarray(vals_j).astype(int) - vals_np.astype(int))) <= 1
+
+
+def test_qtensor_pytree():
+    import jax
+
+    x = _xorshift_data(4 * 64).reshape(4, 64)
+    qt = QTensor.from_float(x, FloatType.Q40)
+    leaves, treedef = jax.tree_util.tree_flatten(qt)
+    assert len(leaves) == 2
+    qt2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert qt2.ftype == FloatType.Q40 and qt2.shape == (4, 64)
+    np.testing.assert_allclose(qt2.to_numpy(), dequantize_q40(*quantize_q40(x)))
+
+
+def test_qtensor_dense():
+    x = _xorshift_data(8).reshape(2, 4)
+    for ft in (FloatType.F32, FloatType.F16):
+        qt = QTensor.from_float(x, ft)
+        assert qt.scales is None
+        np.testing.assert_allclose(qt.to_numpy(), x, atol=1e-3)
