@@ -1,0 +1,1 @@
+from .spec import ArchType, HiddenAct, ModelSpec, RopeType  # noqa: F401

@@ -1,0 +1,202 @@
+"""Unified transformer forward pass for Llama / Mixtral / Grok-1.
+
+TPU-native replacement for the reference's hand-unrolled task graphs
+(src/llama2-tasks.cpp:241-298, src/grok1-tasks.cpp:275-354, src/mixtral-tasks.cpp:5-78).
+The 25-tasks-per-layer lockstep lists collapse into one `lax.scan` over stacked layer
+params; the sync tasks (syncUnitBuffer broadcast / syncSliceOfSlicedBuffer gather+merge,
+src/tasks.cpp:44-94) collapse into `psum`/`all_gather` at exactly the points where the
+reference gathers partial sums.
+
+The SAME function is the single-device program and the per-shard program: pass
+`axis_name="tp"` when tracing under shard_map and every shard-local partial result is
+reduced with `psum` where the reference's root merged slices (llamaMergeAtt,
+llama2-tasks.cpp:125-131). This makes sliced==unsliced a *structural* property, which the
+TP equivalence tests check on an 8-device mesh.
+
+Arch-specific structure:
+- LLAMA (dense): pre-norm attention + SwiGLU FFN (w1=gate, w3=up, w2=down).
+- MIXTRAL: attention as llama; FFN -> top-2-of-8 MoE (router softmax over all experts,
+  top-k renormalized, hb_e = up_e(x) * act(gate_e(x)), out = sum w_ae * down_e(hb_e)).
+- GROK1: embedding x78.38367176906169 (grok1-tasks.cpp:11-14); attention output is
+  rmsnorm'd (rms_ffn) BEFORE the residual join (grokRmfFfn*, grok1-tasks.cpp:16-41);
+  MoE input norm uses rms_moe; MoE output is rmsnorm'd with rms_ffn2 before its residual
+  join; logits x0.5773502691896257 (grokFinalize2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import gqa_attention, update_kv_cache
+from ..ops.kernels import gelu_tanh, rmsnorm, silu
+from ..ops.matmul import qmatmul
+from ..ops.rope import RopeTables, apply_rope
+from .spec import ArchType, HiddenAct, ModelSpec
+
+GROK_EMBEDDING_SCALE = 78.38367176906169  # grok1-tasks.cpp:13
+GROK_LOGITS_SCALE = 0.5773502691896257  # grok1-tasks.cpp:272
+
+
+def _act(spec: ModelSpec):
+    return silu if spec.hidden_act == HiddenAct.SILU else gelu_tanh
+
+
+def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> jax.Array:
+    """TP merge point: the reference's gather-partials-and-sum-at-root
+    (syncSliceOfSlicedBuffer + merge) becomes an all-reduce over the tp axis.
+    `compress` swaps in the int8 Q80-payload all-reduce (the wire-compression
+    equivalent of tasks.cpp:96-135)."""
+    if axis_name is None:
+        return x
+    from ..parallel.collectives import psum
+
+    return psum(x, axis_name, compress=compress)
+
+
+def _attention(x, bp, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos, positions,
+               axis_name, use_pallas, compress):
+    """Sharded attention sub-block. Head counts in bp may be TP-local slices."""
+    b, t, _ = x.shape
+    hs = spec.head_size
+    xb = rmsnorm(x, bp["rms_att"], spec.norm_eps)
+    q = qmatmul(xb, bp["wq"], use_pallas=use_pallas)
+    k = qmatmul(xb, bp["wk"], use_pallas=use_pallas)
+    v = qmatmul(xb, bp["wv"], use_pallas=use_pallas)
+    hq_local = q.shape[-1] // hs
+    hk_local = k.shape[-1] // hs
+    q = apply_rope(q.reshape(b, t, hq_local, hs), rope, positions)
+    k = apply_rope(k.reshape(b, t, hk_local, hs), rope, positions)
+    v = v.reshape(b, t, hk_local, hs)
+    kc, vc = update_kv_cache(kc, vc, k, v, start_pos)
+    att = gqa_attention(q, kc, vc, positions)
+    # col-parallel wo: local heads x local input slice -> partial (B, T, dim); psum merges
+    attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas), axis_name, compress)
+    return attn_out, kc, vc
+
+
+def _dense_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
+    act = _act(spec)
+    h = act(qmatmul(xb, bp["w1"], use_pallas=use_pallas)) * qmatmul(
+        xb, bp["w3"], use_pallas=use_pallas)
+    return _maybe_psum(qmatmul(h, bp["w2"], use_pallas=use_pallas), axis_name, compress)
+
+
+def _gather_expert(w, idx):
+    """Select expert slices of a stacked QTensor (E, out, in) -> (B, T, K, out, in)."""
+    return jax.tree_util.tree_map(lambda a: a[idx], w)
+
+
+def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
+    """Top-k MoE FFN (grokMoeRouter..grokMoeBlock2, grok1-tasks.cpp:56-228).
+
+    Router runs replicated (the reference runs it root-only and broadcasts indexes);
+    expert weights are TP-sliced on the hidden axis exactly like the dense FFN, so the
+    down-matmul partial sums psum across the tp axis.
+    """
+    b, t, d = xb.shape
+    k = spec.n_active_experts
+    act = _act(spec)
+
+    router_logits = qmatmul(xb, bp["router"], use_pallas=False).astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)  # softmax over ALL experts
+    top_p, top_i = jax.lax.top_k(probs, k)  # (B, T, K)
+    weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)  # renormalize (grokMoeNormWeights)
+
+    if b * t * k <= spec.n_experts:
+        # Decode: gather the K active experts' (sliced) weight matrices per token,
+        # dequantize, matmul. Moves exactly the active experts' bytes out of HBM — the
+        # same bandwidth shape as the reference's per-expert forward calls.
+        up_w = _gather_expert(bp["moe_up"], top_i).dequantize(dtype=xb.dtype)  # (B,T,K,h0,d)
+        gate_w = _gather_expert(bp["moe_gate"], top_i).dequantize(dtype=xb.dtype)
+        down_w = _gather_expert(bp["moe_down"], top_i).dequantize(dtype=xb.dtype)
+        hb = jnp.einsum("btd,btkhd->btkh", xb, up_w) * act(
+            jnp.einsum("btd,btkhd->btkh", xb, gate_w))
+        out = jnp.einsum("btkh,btkdh->btkd", hb, down_w)
+        out = jnp.einsum("btkd,btk->btd", out, weights.astype(xb.dtype))
+    else:
+        # Prefill: per-token weight gathers would materialize (B,T,K,h,d); instead scan
+        # expert-major — each step dequantizes ONE expert's matrices and masks its
+        # contribution by the routing weights (zero for tokens that didn't pick it).
+        one_hot = jax.nn.one_hot(top_i, spec.n_experts, dtype=xb.dtype)  # (B,T,K,E)
+        combine = jnp.einsum("btke,btk->ebt", one_hot, weights.astype(xb.dtype))
+
+        def expert_step(acc, ew):
+            up_e, gate_e, down_e, comb = ew  # QTensors (h0,d)/(d,h0), comb (B,T)
+            hb = qmatmul(xb, up_e, use_pallas=use_pallas) * act(
+                qmatmul(xb, gate_e, use_pallas=use_pallas))
+            out_e = qmatmul(hb, down_e, use_pallas=use_pallas)
+            return acc + out_e * comb[..., None], None
+
+        out, _ = jax.lax.scan(
+            expert_step, jnp.zeros_like(xb),
+            (bp["moe_up"], bp["moe_gate"], bp["moe_down"], combine))
+    return _maybe_psum(out, axis_name, compress)
+
+
+def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions,
+           axis_name, use_pallas, compress):
+    x = carry
+    bp, kc, vc = layer
+    attn_out, kc, vc = _attention(x, bp, spec, rope, kc, vc, start_pos, positions,
+                                  axis_name, use_pallas, compress)
+    if spec.arch_type == ArchType.GROK1:
+        # grok: residual-join the *normalized* attention output (grokRmfFfn/Norm/Join)
+        x = x + rmsnorm(attn_out, bp["rms_ffn"], spec.norm_eps)
+        xb = rmsnorm(x, bp["rms_moe"], spec.norm_eps)
+        moe_out = _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
+        x = x + rmsnorm(moe_out, bp["rms_ffn2"], spec.norm_eps)
+    else:
+        x = x + attn_out
+        xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
+        if spec.is_moe:
+            x = x + _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
+        else:
+            x = x + _dense_ffn(xb, bp, spec, axis_name, use_pallas, compress)
+    return x, (kc, vc)
+
+
+def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
+            tokens: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+            start_pos: jax.Array, *, dtype=jnp.float32, axis_name: str | None = None,
+            use_pallas: bool = False, compress_collectives: bool = False):
+    """Run T tokens through the model against the KV cache.
+
+    tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar.
+    Returns (logits (B, T, vocab) f32, new_k_cache, new_v_cache).
+
+    Equivalent of Inference::infer (tasks.cpp:173-184) for the whole token chunk; the
+    embedding-row copy at tasks.cpp:176-177 is the take() below, the task loop is the scan.
+    """
+    t = tokens.shape[1]
+    positions = start_pos + jnp.arange(t, dtype=jnp.int32)
+    x = jnp.take(params["embedding"], tokens, axis=0).astype(dtype)
+    if spec.arch_type == ArchType.GROK1:
+        x = x * GROK_EMBEDDING_SCALE
+
+    block_fn = functools.partial(_block, spec=spec, rope=rope, start_pos=start_pos,
+                                 positions=positions, axis_name=axis_name,
+                                 use_pallas=use_pallas, compress=compress_collectives)
+    x, (k_cache, v_cache) = jax.lax.scan(block_fn, x,
+                                         (params["blocks"], k_cache, v_cache))
+
+    x = rmsnorm(x, params["rms_final"], spec.norm_eps)
+    logits = qmatmul(x, params["wcls"], use_pallas=use_pallas, out_dtype=jnp.float32)
+    if axis_name is not None:
+        # wcls is row(vocab)-sharded: concatenate the vocab shards
+        logits = jax.lax.all_gather(logits, axis_name, axis=-1, tiled=True)
+    if spec.arch_type == ArchType.GROK1:
+        logits = logits * GROK_LOGITS_SCALE
+    return logits, k_cache, v_cache
+
+
+def init_kv_cache(spec: ModelSpec, batch: int = 1, dtype=jnp.float32,
+                  n_kv_heads: int | None = None, seq_len: int | None = None):
+    """Zeroed head-major KV caches (L, B, hk, S, hs); hk may be a TP-local count."""
+    hk = n_kv_heads if n_kv_heads is not None else spec.n_kv_heads
+    s = seq_len if seq_len is not None else spec.seq_len
+    shape = (spec.n_layers, batch, hk, s, spec.head_size)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

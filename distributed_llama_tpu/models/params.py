@@ -1,0 +1,117 @@
+"""Parameter pytrees for all supported architectures.
+
+Layout convention: every per-layer tensor is STACKED along a leading n_layers axis so the
+forward pass can `lax.scan` over layers (one compiled block program instead of the
+reference's hand-unrolled 25-tasks-per-layer lists, llama2-tasks.cpp:246-276).
+
+Weight matrices keep the reference's (out, in) row-major orientation with quantization
+blocks along `in`. Tensor inventory mirrors the `.m` file exactly
+(transformer.cpp:494-529):
+
+    embedding (vocab, dim) f32           wcls (vocab, dim) [weights ftype]
+    per layer: wq (dim, dim), wk (kv_dim, dim), wv (kv_dim, dim), wo (dim, dim),
+       dense: w1/gate (hidden, dim), w2/down (dim, hidden), w3/up (hidden, dim)
+       moe:   router (n_experts, dim), moe_up/moe_gate (E, hidden, dim),
+              moe_down (E, dim, hidden)
+       norms: rms_att (dim,), rms_ffn (dim,) [+ grok1: rms_moe, rms_ffn2]
+    rms_final (dim,) f32
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ..quants import FloatType, QTensor
+from .spec import ArchType, ModelSpec
+
+Params = dict[str, Any]
+
+
+def block_tensor_shapes(spec: ModelSpec) -> dict[str, tuple[tuple[int, ...], bool]]:
+    """Per-layer tensor name -> (shape-without-layer-axis, is_quantized_matmul).
+
+    Order matters: it is the `.m` file tensor order within a layer
+    (transformer.cpp:498-523).
+    """
+    d, h, kv, e = spec.dim, spec.hidden_dim, spec.kv_dim, spec.n_experts
+    shapes: dict[str, tuple[tuple[int, ...], bool]] = {
+        "wq": ((d, d), True),
+        "wk": ((kv, d), True),
+        "wv": ((kv, d), True),
+        "wo": ((d, d), True),
+    }
+    if spec.is_moe:
+        shapes["router"] = ((e, d), True)
+        shapes["moe_up"] = ((e, h, d), True)
+        shapes["moe_gate"] = ((e, h, d), True)
+        shapes["moe_down"] = ((e, d, h), True)
+    else:
+        shapes["w1"] = ((h, d), True)
+        shapes["w2"] = ((d, h), True)
+        shapes["w3"] = ((h, d), True)
+    shapes["rms_att"] = ((d,), False)
+    shapes["rms_ffn"] = ((d,), False)
+    if spec.arch_type == ArchType.GROK1:
+        shapes["rms_moe"] = ((d,), False)
+        shapes["rms_ffn2"] = ((d,), False)
+    return shapes
+
+
+def init_random_params(spec: ModelSpec, weights_ftype: FloatType = FloatType.F32,
+                       seed: int = 0, scale: float = 0.02) -> Params:
+    """Random-weight model for tests/benchmarks (the reference's golden-test pattern:
+    seeded random weights, llama2-tasks-test.cpp:527-608)."""
+    rng = np.random.RandomState(seed)
+
+    def randn(*shape):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    L = spec.n_layers
+    blocks: Params = {}
+    for name, (shape, quantized) in block_tensor_shapes(spec).items():
+        full = randn(L, *shape)
+        if quantized:
+            blocks[name] = QTensor.from_float(full, weights_ftype)
+        else:
+            blocks[name] = full + 1.0  # norm weights around 1
+    return {
+        "embedding": randn(spec.vocab_size, spec.dim),
+        "blocks": blocks,
+        "rms_final": randn(spec.dim) + 1.0,
+        "wcls": QTensor.from_float(randn(spec.vocab_size, spec.dim), weights_ftype),
+    }
+
+
+# col-parallel (input-dim-sharded) tensors need shard-local TPU repacking
+_COL_PARALLEL = {"wo", "w2", "moe_down"}
+
+
+def prepare_for_pallas(params: Params, tp: int = 1) -> Params:
+    """Repack every 2-D-logical Q40 matmul weight into the Pallas kernel's block-strided
+    layout (quants.q40_repack_tpu). `tp` must match the mesh's tp size so col-parallel
+    slices remain self-contained permuted segments."""
+    out: Params = {"embedding": params["embedding"], "blocks": {},
+                   "rms_final": params["rms_final"]}
+    for name, t in params["blocks"].items():
+        if isinstance(t, QTensor) and t.ftype == FloatType.Q40:
+            out["blocks"][name] = t.to_tpu_layout(tp if name in _COL_PARALLEL else 1)
+        else:
+            out["blocks"][name] = t
+    wcls = params["wcls"]
+    if isinstance(wcls, QTensor) and wcls.ftype == FloatType.Q40:
+        wcls = wcls.to_tpu_layout(1)
+    out["wcls"] = wcls
+    return out
+
+
+def map_params(params: Params, fn: Callable[[Any], Any]) -> Params:
+    """Apply fn to every QTensor/array leaf group (QTensor treated atomically)."""
+    out: Params = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            out[k] = map_params(v, fn)
+        else:
+            out[k] = fn(v)
+    return out

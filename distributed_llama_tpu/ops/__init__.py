@@ -1,0 +1,4 @@
+from .kernels import gelu_tanh, rmsnorm, silu  # noqa: F401
+from .rope import RopeTables, apply_rope  # noqa: F401
+from .attention import gqa_attention  # noqa: F401
+from .matmul import qmatmul  # noqa: F401

@@ -1,0 +1,54 @@
+"""Grouped-query causal attention over a resident KV cache.
+
+TPU-native replacement for the reference's per-head scalar attention loop
+(src/llama2-tasks.cpp:54-94: per head, dot q·k over 0..pos, softmax, weighted sum of v).
+Here the whole (heads x positions) score matrix is one batched einsum on the MXU, masked
+and softmaxed on the VPU, for T query tokens at once — which also gives chunked prefill,
+something the reference (token-at-a-time prefill) lacks.
+
+Shapes (batch-first, head-major cache):
+    q: (B, T, n_q_heads, hs)     k_cache/v_cache: (B, n_kv_heads, S, hs)
+TP slices along the kv-head axis (reference MultiHeadAttSlice, commands.cpp:104-108);
+sequence parallelism slices along S (ring attention, see ops/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import masked_softmax
+
+
+def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                  positions: jax.Array) -> jax.Array:
+    """Causal GQA attention of T query tokens (at absolute `positions`, shape (T,))
+    against the full cache. Returns (B, T, n_q_heads * hs)."""
+    b, t, hq, hs = q.shape
+    _, hk, s, _ = k_cache.shape
+    g = hq // hk
+    qg = q.reshape(b, t, hk, g, hs)
+    scale = 1.0 / math.sqrt(hs)
+    # (B, hk, g, T, S)
+    scores = jnp.einsum("btkgd,bksd->bkgts", qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    valid = jnp.arange(s)[None, :] <= positions[:, None]  # (T, S) causal mask
+    probs = masked_softmax(scores, valid[None, None, None, :, :])
+    out = jnp.einsum("bkgts,bksd->btkgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(b, t, hq * hs).astype(q.dtype)
+
+
+def update_kv_cache(k_cache: jax.Array, v_cache: jax.Array, k_new: jax.Array,
+                    v_new: jax.Array, start_pos: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Write T new kv vectors at [start_pos, start_pos+T) into head-major caches.
+
+    k_new/v_new: (B, T, n_kv_heads, hs) -> caches (B, n_kv_heads, S, hs).
+    Replaces the reference's direct in-cache matmul write (llama2-tasks.cpp:38-44).
+    """
+    k_t = jnp.swapaxes(k_new, 1, 2).astype(k_cache.dtype)  # (B, hk, T, hs)
+    v_t = jnp.swapaxes(v_new, 1, 2).astype(v_cache.dtype)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (0, 0, start_pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, start_pos, 0))
+    return k_cache, v_cache

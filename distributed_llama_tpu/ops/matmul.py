@@ -1,0 +1,39 @@
+"""Quantized matmul dispatch — TPU equivalent of the reference matmul layer.
+
+The reference dispatches on (weightType x inputType) pairs of hand-written SIMD loops
+(src/funcs.cpp:424-465, hot path matmulQ40vQ80 at funcs.cpp:287-396). Here there is ONE
+logical op: y[..., out] = x[..., in] · W[out, in], where W may be dense or block-quantized.
+
+Two execution paths:
+- `qmatmul` (this module): dequantize-to-dtype + `jnp.einsum`; XLA fuses the nibble unpack
+  and scale broadcast into the matmul's operand pipeline. Correct everywhere (CPU mesh
+  tests, TPU), and the baseline the Pallas kernel must beat.
+- `pallas_q40.q40_matmul`: fused HBM->VMEM dequant matmul kernel (see ops/pallas_q40.py),
+  enabled via `use_pallas=True` when running on real TPU.
+
+Weights keep the reference's (out, in) row-major orientation with quant blocks along `in`
+(src/commands.cpp:22-39), so TP row/col splits slice whole blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..quants import FloatType, QTensor
+
+
+def qmatmul(x: jax.Array, w: QTensor, *, use_pallas: bool = False,
+            out_dtype=None) -> jax.Array:
+    """y = x @ W^T for W of logical shape (out, in); x: (..., in) -> (..., out)."""
+    if use_pallas and w.ftype == FloatType.Q40 and w.layout == "tpu" and w.data.ndim == 2:
+        from .pallas_q40 import q40_matmul
+
+        return q40_matmul(x, w, out_dtype=out_dtype or x.dtype)
+    wd = w.dequantize(dtype=x.dtype)
+    y = jax.lax.dot_general(
+        x, wd,
+        dimension_numbers=(((x.ndim - 1,), (wd.ndim - 1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return y.astype(out_dtype or x.dtype)

@@ -1,0 +1,92 @@
+"""Fused Q40 dequant-matmul Pallas kernel — the TPU descendant of matmulQ40vQ80.
+
+The reference's hot loop (src/funcs.cpp:287-396) dot-products 4-bit weight blocks against
+int8 activations with NEON `vdotq_s32`, rows split across threads. Here the same
+weight-stationary idea maps to TPU: packed nibbles stream HBM -> VMEM (4.5 bits/weight of
+HBM traffic instead of 16 for bf16), the VPU unpacks and scales them, and the MXU
+contracts against the activations — the dequantized weight matrix is never materialized
+in HBM (the jnp fallback in ops/matmul.py may be, at XLA's discretion).
+
+Weights must be in the block-strided "tpu" layout (quants.q40_repack_tpu): element
+(block b, intra i) at column i*nb + b. That makes both Mosaic-hostile ops disappear:
+- scale broadcast: lane j's scale is scales[j % nb] == pltpu.repeat(scales, 32) (tile
+  semantics), no (BN, nb, 32)->(BN, K) reshape;
+- nibble halves: low nibbles are permuted columns [0, K/2), high [K/2, K) — a lane-axis
+  concat, no interleave.
+The matching activation permutation (quants.permute_activations_tpu) runs in XLA outside
+the kernel, where it fuses with the producer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quants import QK, FloatType, QTensor, permute_activations_tpu
+
+
+def _q40_kernel(x_ref, p_ref, s_ref, o_ref, *, nb: int, precise: bool):
+    # Mosaic has no sub-32-bit integer arithmetic: widen bytes to int32 first
+    mm_dtype = jnp.float32 if precise else jnp.bfloat16
+    p = p_ref[:].astype(jnp.int32)  # (BN, K//2) from uint8, permuted layout
+    lo = (p & 0x0F).astype(mm_dtype) - 8.0  # permuted cols [0, K/2)
+    hi = ((p >> 4) & 0x0F).astype(mm_dtype) - 8.0  # permuted cols [K/2, K)
+    w_int = jnp.concatenate([lo, hi], axis=1)  # (BN, K)
+    s_full = pltpu.repeat(s_ref[:].astype(mm_dtype), QK, axis=1)  # lane j -> scales[j % nb]
+    w = w_int * s_full
+    # precise: f32 multiplies via HIGHEST (MXU default is bf16) — used by parity tests;
+    # fast path: bf16 operands, f32 accumulate (standard inference numerics). Decode is
+    # HBM-bandwidth-bound either way.
+    o_ref[:] = jax.lax.dot_general(
+        x_ref[:].astype(mm_dtype), w, dimension_numbers=(((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST if precise else None,
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret", "precise"))
+def _q40_matmul_2d(x, packed2, scales, *, block_n: int = 512, interpret: bool = False,
+                   precise: bool = False):
+    """y (M, N) f32 = x (M, K) · W^T from TPU-layout Q40 (N, K//2)+(N, K//32)."""
+    m, k = x.shape
+    n, k2 = packed2.shape
+    nb = scales.shape[-1]
+    assert k2 * 2 == k and nb * QK == k, (packed2.shape, x.shape, scales.shape)
+    bn = block_n
+    while n % bn:
+        bn //= 2
+    x_perm = permute_activations_tpu(x, nb)
+
+    return pl.pallas_call(
+        functools.partial(_q40_kernel, nb=nb, precise=precise),
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, k2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=interpret,
+    )(x_perm, packed2, scales)
+
+
+def q40_matmul(x: jax.Array, w: QTensor, *, out_dtype=None,
+               interpret: bool | None = None, precise: bool | None = None) -> jax.Array:
+    """qmatmul entry point: x (..., K) x tpu-layout Q40 QTensor (N, K) -> (..., N)."""
+    if w.layout != "tpu":
+        raise ValueError(
+            "q40_matmul needs tpu-layout weights; run models.params.prepare_for_pallas "
+            "(or QTensor.to_tpu_layout) on the params first")
+    assert w.ftype == FloatType.Q40 and w.data.ndim == 2, (w.ftype, w.data.shape)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if precise is None:
+        precise = x.dtype == jnp.float32
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    y = _q40_matmul_2d(x2, w.data, w.scales, interpret=interpret, precise=precise)
+    return y.reshape(*lead, y.shape[-1]).astype(out_dtype or x.dtype)

@@ -1,0 +1,103 @@
+"""Rotary position embeddings — the three styles of the reference (src/commands.cpp:140-257).
+
+- ROPE_LLAMA: interleaved pairs (2k, 2k+1), freq_k = theta^(-2k/head_size), precomputed
+  cos/sin tables over the full sequence (LlamaRopeCommand, commands.cpp:140-179).
+- ROPE_LLAMA3_1: same rotation with Llama-3.1 frequency-dependent NTK scaling. NOTE: the
+  reference (Llama3_1RopeCommand::forward, commands.cpp:207-227) applies `scale()` to the
+  *rotated output values* — an upstream bug; the correct (and Meta-official) behavior is to
+  scale the *frequencies*, which is what we do here.
+- ROPE_FALCON: GPT-NeoX half-rotation layout, pairs (j, j+hs/2), freq_j = theta^(-2j/hs)
+  (FalconRopeCommand, commands.cpp:229-257); used by Grok-1 and Mixtral.
+
+Tables are computed once per model (host numpy, f32) and live on device; application is a
+pure jnp function usable inside jit/scan/shard_map. Slicing across TP devices is by whole
+heads, and both layouts rotate within a head, so sliced==unsliced holds by construction —
+the property the reference's commands-test.cpp checks explicitly.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.spec import ModelSpec, RopeType
+
+
+def _llama31_scale_freqs(freqs: np.ndarray, factor: float, low_freq_factor: float,
+                         high_freq_factor: float, orig_max_seq_len: int) -> np.ndarray:
+    """Llama-3.1 NTK-by-parts frequency scaling (correct form of commands.cpp:193-205)."""
+    wavelens = 2.0 * math.pi / freqs
+    low_freq_wavelen = orig_max_seq_len / low_freq_factor
+    high_freq_wavelen = orig_max_seq_len / high_freq_factor
+    smooth = (orig_max_seq_len / wavelens - low_freq_factor) / (high_freq_factor - low_freq_factor)
+    scaled = np.where(
+        wavelens < high_freq_wavelen,
+        freqs,
+        np.where(wavelens > low_freq_wavelen, freqs / factor,
+                 (1.0 - smooth) * freqs / factor + smooth * freqs),
+    )
+    return scaled
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class RopeTables:
+    """Precomputed per-position cos/sin, shape (seq_len, head_size // 2)."""
+
+    cos: jax.Array
+    sin: jax.Array
+    rope_type: RopeType
+
+    def tree_flatten(self):
+        return (self.cos, self.sin), (self.rope_type,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+    @classmethod
+    def create(cls, spec: ModelSpec) -> "RopeTables":
+        hs = spec.head_size
+        k = np.arange(hs // 2, dtype=np.float64)
+        freqs = 1.0 / (spec.rope_theta ** (2.0 * k / hs))
+        if spec.rope_type == RopeType.LLAMA3_1:
+            freqs = _llama31_scale_freqs(
+                freqs, spec.rope_scaling_factor, spec.rope_scaling_low_freq_factor,
+                spec.rope_scaling_high_freq_factor, spec.rope_scaling_orig_max_seq_len)
+        t = np.arange(spec.seq_len, dtype=np.float64)
+        angles = np.outer(t, freqs)  # (seq_len, hs//2)
+        return cls(
+            cos=jnp.asarray(np.cos(angles), dtype=jnp.float32),
+            sin=jnp.asarray(np.sin(angles), dtype=jnp.float32),
+            rope_type=spec.rope_type,
+        )
+
+
+def apply_rope(x: jax.Array, tables: RopeTables, positions: jax.Array) -> jax.Array:
+    """Rotate q or k. x: (..., T, n_heads, head_size); positions: (T,) int32.
+
+    Both interleaved (llama) and half-rotation (neox/falcon) layouts rotate pair
+    (a, b) -> (a*cos - b*sin, a*sin + b*cos); only the pairing differs.
+    """
+    cos = tables.cos[positions][..., :, None, :]  # (..., T, 1, hs//2)
+    sin = tables.sin[positions][..., :, None, :]
+    hs = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    if tables.rope_type in (RopeType.LLAMA, RopeType.LLAMA3_1):
+        xp = xf.reshape(*x.shape[:-1], hs // 2, 2)
+        a, b = xp[..., 0], xp[..., 1]
+        ra = a * cos - b * sin
+        rb = a * sin + b * cos
+        out = jnp.stack([ra, rb], axis=-1).reshape(x.shape)
+    elif tables.rope_type == RopeType.FALCON:
+        a, b = xf[..., : hs // 2], xf[..., hs // 2 :]
+        ra = a * cos - b * sin
+        rb = a * sin + b * cos
+        out = jnp.concatenate([ra, rb], axis=-1)
+    else:
+        raise ValueError(tables.rope_type)
+    return out.astype(x.dtype)

@@ -1,0 +1,76 @@
+"""Partition specs — the TPU equivalent of the reference's slicing layer.
+
+Maps one-to-one onto src/commands.cpp:
+    RowMatmulSlice  (split output dim d; commands.cpp:11-43)  -> 'tp' on the out axis
+    ColMatmulSlice  (split input dim n; commands.cpp:45-73)   -> 'tp' on the in axis
+                                                                  (+ psum in forward)
+    KvCacheSlice    (kvDim/nSlices per node; commands.cpp:97-102) -> 'tp' on the kv-head
+                                                                      axis of the cache
+    MultiHeadAttSlice (nHeads/nSlices; commands.cpp:104-108)  -> implied by row-split QKV
+    RopeSlice       (commands.cpp:75-95)                      -> nothing: rope rotates
+                                                                  within a head, slicing
+                                                                  is by whole heads
+
+Because quantization blocks run along the `in` axis and a QTensor's packed/scales arrays
+keep `out` and `in`(-block) at the same axis indices, ONE PartitionSpec per tensor works
+as a pytree prefix for both leaves, and every slice boundary lands on a 32-block boundary
+by construction (the reference asserts this dynamically, commands.cpp:16-19).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jax.sharding import PartitionSpec as P
+
+from ..models.spec import ModelSpec
+from .mesh import AXIS_TP
+
+# per-layer matmul tensors: axis index (within the stacked (L, ...) logical shape) that
+# 'tp' shards. out-splits mirror RowMatmulSlice, in-splits mirror ColMatmulSlice.
+_BLOCK_SPECS = {
+    "wq": P(None, AXIS_TP),          # (L, dim->tp, dim)
+    "wk": P(None, AXIS_TP),          # (L, kv_dim->tp, dim)
+    "wv": P(None, AXIS_TP),
+    "wo": P(None, None, AXIS_TP),    # (L, dim, dim->tp) partial-sum
+    "w1": P(None, AXIS_TP),          # (L, hidden->tp, dim)
+    "w3": P(None, AXIS_TP),
+    "w2": P(None, None, AXIS_TP),    # (L, dim, hidden->tp) partial-sum
+    "router": P(),                    # replicated (root-only in reference)
+    "moe_up": P(None, None, AXIS_TP),    # (L, E, hidden->tp, dim)
+    "moe_gate": P(None, None, AXIS_TP),
+    "moe_down": P(None, None, None, AXIS_TP),  # (L, E, dim, hidden->tp)
+    "rms_att": P(),
+    "rms_ffn": P(),
+    "rms_moe": P(),
+    "rms_ffn2": P(),
+}
+
+
+def param_pspecs(params: dict[str, Any]) -> dict[str, Any]:
+    """PartitionSpec pytree (prefix) matching a params dict."""
+    blocks = {k: _BLOCK_SPECS[k] for k in params["blocks"]}
+    return {
+        "embedding": P(),  # replicated, root-only-F32 in reference (transformer.cpp:496)
+        "blocks": blocks,
+        "rms_final": P(),
+        "wcls": P(AXIS_TP),  # (vocab->tp, dim); logits all-gathered in forward
+    }
+
+
+def kv_cache_pspec(seq_axis: str | None = None) -> P:
+    """Cache (L, B, hk, S, hs): heads on tp (KvCacheSlice), optionally S on sp."""
+    return P(None, None, AXIS_TP, seq_axis)
+
+
+def check_divisibility(spec: ModelSpec, tp: int) -> None:
+    """The reference's hard constraint nSlices <= nKvHeads (transformer.cpp:108-111),
+    plus even-division checks that replace its 2^n assumption."""
+    assert spec.n_kv_heads % tp == 0, (
+        f"tp={tp} must divide n_kv_heads={spec.n_kv_heads} "
+        "(KV-head replication not yet enabled)")
+    assert spec.n_heads % tp == 0
+    assert spec.dim % tp == 0 and spec.hidden_dim % tp == 0
+    assert spec.vocab_size % tp == 0
+    if (spec.dim // tp) % 32 or (spec.hidden_dim // tp) % 32:
+        raise AssertionError("tp slice must keep 32-wide quant blocks intact")

@@ -170,6 +170,19 @@ def jnp_dequantize_q40(packed: jax.Array, scales: jax.Array, dtype=jnp.bfloat16)
     return out.reshape(*packed.shape[:-2], packed.shape[-2] * QK)
 
 
+def jnp_dequantize_q40_tpu(packed2: jax.Array, scales: jax.Array,
+                           dtype=jnp.bfloat16) -> jax.Array:
+    """Dequantize the TPU-permuted layout (single segment) back to natural order."""
+    nb = scales.shape[-1]
+    lead = packed2.shape[:-1]
+    p = packed2.reshape(*lead, 16, nb)
+    lo = (p & 0x0F).astype(jnp.int8) - 8
+    hi = (p >> 4).astype(jnp.int8) - 8
+    w = jnp.concatenate([lo, hi], axis=-2)  # (..., 32, nb) intra-major
+    w = jnp.swapaxes(w, -1, -2).astype(dtype) * scales[..., None].astype(dtype)
+    return w.reshape(*lead, nb * QK)
+
+
 def jnp_dequantize_q80(values: jax.Array, scales: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
     out = values.astype(dtype) * scales[..., None].astype(dtype)
     return out.reshape(*values.shape[:-2], values.shape[-2] * QK)
@@ -191,6 +204,72 @@ def jnp_quantize_q80(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 # ---------------------------------------------------------------------------
+# TPU-permuted Q40 layout for the Pallas fused dequant-matmul kernel
+# ---------------------------------------------------------------------------
+#
+# Mosaic cannot reshape (BN, nb, 32) -> (BN, K) in registers, so the kernel needs a layout
+# where scales broadcast along lanes WITHOUT a reshape. pltpu.repeat has tile semantics
+# ([s0..s_nb] * 32), so we permute weight columns block-strided: element (block b,
+# intra i) lives at column i*nb + b. Then lane j's scale is s[j % nb] == tile-repeat, and
+# the nibble halves unpack into two contiguous lane ranges (i<16 -> low nibbles,
+# i>=16 -> high). Activations get the same column permutation (cheap XLA transpose).
+#
+# `n_shards` makes the permutation local to each of n contiguous K-segments so a
+# col-parallel (input-dim) TP shard of the packed array is itself a valid permuted layout.
+
+
+def q40_repack_tpu(packed: np.ndarray, scales: np.ndarray, n_shards: int = 1) -> np.ndarray:
+    """Planar Q40 packed (..., nb, 16) -> TPU-permuted packed2 (..., nb*16).
+
+    packed2[..., j] holds (for each K-shard segment independently, nb_l = nb/n_shards):
+    low nibble = element at permuted pos j = i*nb_l+b for i<16, high nibble = same j with
+    i+16. scales stay (..., nb) unchanged.
+    """
+    nb = packed.shape[-2]
+    assert nb % n_shards == 0, (nb, n_shards)
+    nb_l = nb // n_shards
+    lead = packed.shape[:-2]
+    q = packed.reshape(*lead, n_shards, nb_l, 16)
+    lo = q & 0x0F  # intra i = 0..15, element (b, i)
+    hi = q >> 4  # intra i = 16..31
+    # permuted: pos j = i*nb_l + b  ->  transpose (nb_l, 16) -> (16, nb_l)
+    lo_p = np.swapaxes(lo, -1, -2).reshape(*lead, n_shards, nb_l * 16)
+    hi_p = np.swapaxes(hi, -1, -2).reshape(*lead, n_shards, nb_l * 16)
+    out = (lo_p | (hi_p << 4)).astype(np.uint8)
+    return out.reshape(*lead, nb * 16)
+
+
+def permute_activations_tpu(x, nb: int, n_shards: int = 1):
+    """Match q40_repack_tpu's column permutation on the activation side (jnp or numpy).
+
+    x: (..., K) with K = nb*32 -> same shape, columns permuted per K-shard segment.
+    """
+    xp = jnp if isinstance(x, jax.Array) else np
+    k = x.shape[-1]
+    assert k == nb * QK, (x.shape, nb)
+    nb_l = nb // n_shards
+    lead = x.shape[:-1]
+    x4 = x.reshape(*lead, n_shards, nb_l, QK)
+    x4 = xp.swapaxes(x4, -1, -2)  # (..., n_shards, 32, nb_l)
+    return x4.reshape(*lead, k)
+
+
+def dequantize_q40_tpu(packed2: np.ndarray, scales: np.ndarray,
+                       n_shards: int = 1) -> np.ndarray:
+    """TPU-permuted packed2 (..., nb*16) + scales (..., nb) -> natural-order floats."""
+    nb = scales.shape[-1]
+    nb_l = nb // n_shards
+    lead = packed2.shape[:-1]
+    p = packed2.reshape(*lead, n_shards, 16, nb_l)
+    lo = (p & 0x0F).astype(np.int8) - 8  # i = 0..15
+    hi = (p >> 4).astype(np.int8) - 8  # i = 16..31
+    w = np.concatenate([lo, hi], axis=-2)  # (..., n_shards, 32, nb_l) intra-major
+    w = np.swapaxes(w, -1, -2).reshape(*lead, nb, QK).astype(np.float32)
+    w = w * scales[..., None].astype(np.float32)
+    return w.reshape(*lead, nb * QK)
+
+
+# ---------------------------------------------------------------------------
 # QTensor: a quantized-or-not weight tensor as a pytree
 # ---------------------------------------------------------------------------
 
@@ -198,53 +277,77 @@ def jnp_quantize_q80(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class QTensor:
-    """A weight tensor of logical shape `shape`, stored dense or block-quantized.
+    """A weight tensor, stored dense or block-quantized.
 
     For Q40/Q80 the block axis is the LAST logical axis (the contraction axis `n` of the
     reference's (d, n) row-major weights; reference blocks run along n — src/commands.cpp:22-39).
     Registered as a pytree so QTensors flow through jit/scan/shard_map and can carry per-leaf
-    shardings.
+    shardings. `shape` is derived from `data`, so it stays correct when transforms (scan
+    unstacking, vmap, gathers) reshape the leaves.
     """
 
     ftype: FloatType
-    shape: tuple[int, ...]
     data: jax.Array | np.ndarray  # dense values, Q40 packed u8, or Q80 int8
     scales: jax.Array | np.ndarray | None = None  # f16 per-block scales for Q40/Q80
+    layout: str = "planar"  # "planar" | "tpu" (block-strided permuted, see q40_repack_tpu)
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        """Logical (dequantized) shape."""
+        if self.ftype in (FloatType.F32, FloatType.F16):
+            return tuple(self.data.shape)
+        if self.ftype == FloatType.Q40 and self.layout == "tpu":
+            return (*self.data.shape[:-1], self.data.shape[-1] * 2)
+        if self.ftype in (FloatType.Q40, FloatType.Q80):
+            return (*self.data.shape[:-2], self.data.shape[-2] * QK)
+        raise ValueError(self.ftype)
 
     def tree_flatten(self):
         if self.scales is None:
-            return (self.data,), (self.ftype, self.shape, False)
-        return (self.data, self.scales), (self.ftype, self.shape, True)
+            return (self.data,), (self.ftype, False, self.layout)
+        return (self.data, self.scales), (self.ftype, True, self.layout)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        ftype, shape, has_scales = aux
+        ftype, has_scales, layout = aux
         if has_scales:
             data, scales = children
         else:
             (data,) = children
             scales = None
-        return cls(ftype=ftype, shape=shape, data=data, scales=scales)
+        return cls(ftype=ftype, data=data, scales=scales, layout=layout)
+
+    def to_tpu_layout(self, n_shards: int = 1) -> "QTensor":
+        """Repack planar Q40 into the Pallas kernel's block-strided layout (host-side)."""
+        assert self.ftype == FloatType.Q40 and self.layout == "planar", (
+            self.ftype, self.layout)
+        packed2 = q40_repack_tpu(np.asarray(self.data), np.asarray(self.scales), n_shards)
+        # Mosaic has no f16 support: carry scales as f32 (exact upcast, dequant unchanged)
+        scales32 = np.asarray(self.scales, dtype=np.float32)
+        return QTensor(self.ftype, packed2, scales32, layout="tpu")
 
     @classmethod
     def from_float(cls, x: np.ndarray, ftype: FloatType) -> "QTensor":
         x = np.asarray(x)
         if ftype == FloatType.F32:
-            return cls(ftype, x.shape, x.astype(np.float32))
+            return cls(ftype, x.astype(np.float32))
         if ftype == FloatType.F16:
-            return cls(ftype, x.shape, x.astype(np.float16))
+            return cls(ftype, x.astype(np.float16))
         if ftype == FloatType.Q40:
             packed, scales = quantize_q40(x)
-            return cls(ftype, x.shape, packed, scales)
+            return cls(ftype, packed, scales)
         if ftype == FloatType.Q80:
             vals, scales = quantize_q80(x)
-            return cls(ftype, x.shape, vals, scales)
+            return cls(ftype, vals, scales)
         raise ValueError(ftype)
 
     def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
         """Materialize logical values on device (jnp path; Pallas kernels bypass this)."""
         if self.ftype in (FloatType.F32, FloatType.F16):
             return jnp.asarray(self.data).astype(dtype)
+        if self.ftype == FloatType.Q40 and self.layout == "tpu":
+            return jnp_dequantize_q40_tpu(jnp.asarray(self.data), jnp.asarray(self.scales),
+                                          dtype)
         if self.ftype == FloatType.Q40:
             return jnp_dequantize_q40(jnp.asarray(self.data), jnp.asarray(self.scales), dtype)
         if self.ftype == FloatType.Q80:
@@ -254,6 +357,8 @@ class QTensor:
     def to_numpy(self) -> np.ndarray:
         if self.ftype in (FloatType.F32, FloatType.F16):
             return np.asarray(self.data, dtype=np.float32)
+        if self.ftype == FloatType.Q40 and self.layout == "tpu":
+            return dequantize_q40_tpu(np.asarray(self.data), np.asarray(self.scales))
         if self.ftype == FloatType.Q40:
             return dequantize_q40(np.asarray(self.data), np.asarray(self.scales))
         if self.ftype == FloatType.Q80:

@@ -14,6 +14,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# sitecustomize.py (axon TPU tunnel) imports jax at interpreter startup, so jax's config
+# snapshot of JAX_PLATFORMS predates this file — override it explicitly.
+jax.config.update("jax_platforms", "cpu")
+# golden tests compare against f32 numpy oracles; don't let matmuls drop to bf16
+jax.config.update("jax_default_matmul_precision", "highest")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,108 @@
+"""Tensor-parallel equivalence: sliced execution == unsliced execution.
+
+The reference proves this only for RoPE slices (commands-test.cpp) and stubs out sockets
+for block tests; here the whole model runs SPMD on a real 2/4/8-device mesh with actual
+collectives, for all three architectures — the multi-device test the reference never
+automated (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributed_llama_tpu.models.forward import forward, init_kv_cache
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec, RopeType
+from distributed_llama_tpu.ops.rope import RopeTables
+from distributed_llama_tpu.parallel import make_mesh, make_sharded_forward, shard_params
+from distributed_llama_tpu.quants import FloatType
+
+
+def tp_spec(arch=ArchType.LLAMA, **kw):
+    defaults = dict(
+        arch_type=arch, dim=256, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=8,
+        vocab_size=256, seq_len=16, rope_type=RopeType.LLAMA,
+    )
+    if arch != ArchType.LLAMA:
+        defaults.update(n_experts=4, n_active_experts=2, rope_type=RopeType.FALCON)
+    if arch == ArchType.GROK1:
+        defaults.update(hidden_act=HiddenAct.GELU)
+    defaults.update(kw)
+    return ModelSpec(**defaults).resolved()
+
+
+def reference_logits(spec, params, tokens):
+    rope = RopeTables.create(spec)
+    kc, vc = init_kv_cache(spec)
+    logits, _, _ = forward(params, spec, rope, tokens, kc, vc, jnp.int32(0))
+    return np.asarray(logits)
+
+
+def tp_logits(spec, params, tokens, tp, **fwd_kw):
+    mesh = make_mesh(tp=tp)
+    rope = RopeTables.create(spec)
+    sp = shard_params(params, mesh, spec)
+    kc, vc = init_kv_cache(spec)
+    step = make_sharded_forward(spec, mesh, sp, donate_cache=False, **fwd_kw)
+    logits, kc2, vc2 = step(sp, rope, tokens, kc, vc, jnp.int32(0))
+    return np.asarray(logits), kc2
+
+
+@pytest.mark.parametrize("tp", [2, 4, 8])
+def test_llama_tp_equivalence(tp):
+    spec = tp_spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    tokens = jnp.asarray(np.arange(1, 6, dtype=np.int32))[None, :]
+    want = reference_logits(spec, params, tokens)
+    got, _ = tp_logits(spec, params, tokens, tp)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+@pytest.mark.parametrize("arch", [ArchType.MIXTRAL, ArchType.GROK1])
+def test_moe_tp_equivalence(arch):
+    spec = tp_spec(arch)
+    params = init_random_params(spec, FloatType.Q40, seed=13)
+    tokens = jnp.asarray(np.arange(1, 5, dtype=np.int32))[None, :]
+    want = reference_logits(spec, params, tokens)
+    got, _ = tp_logits(spec, params, tokens, 4)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+def test_gqa_tp_up_to_kv_heads():
+    """tp == n_kv_heads works (the reference's limit, transformer.cpp:108-111)."""
+    spec = tp_spec(n_heads=8, n_kv_heads=4)
+    params = init_random_params(spec, FloatType.F32, seed=17)
+    tokens = jnp.asarray([[3, 1, 4]])
+    want = reference_logits(spec, params, tokens)
+    got, _ = tp_logits(spec, params, tokens, 4)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+
+
+def test_tp_exceeding_kv_heads_raises():
+    spec = tp_spec(n_heads=8, n_kv_heads=4)
+    params = init_random_params(spec, FloatType.F32, seed=17)
+    tokens = jnp.asarray([[3]])
+    with pytest.raises(AssertionError):
+        tp_logits(spec, params, tokens, 8)
+
+
+def test_compressed_collectives():
+    """Q80-compressed all-reduce (wire-compression parity, tasks.cpp:96-135) stays close
+    to the uncompressed result."""
+    spec = tp_spec()
+    params = init_random_params(spec, FloatType.F32, seed=19)
+    tokens = jnp.asarray([[5, 9, 2]])
+    want = reference_logits(spec, params, tokens)
+    got, _ = tp_logits(spec, params, tokens, 4, compress_collectives=True)
+    assert np.max(np.abs(got - want)) < 0.05
+    # rank-1 token choice must survive compression
+    assert np.argmax(got[0, -1]) == np.argmax(want[0, -1])
+
+
+def test_kv_cache_stays_sharded():
+    spec = tp_spec()
+    params = init_random_params(spec, FloatType.F32, seed=23)
+    tokens = jnp.asarray([[3, 1]])
+    _, kc2 = tp_logits(spec, params, tokens, 4)
+    # cache sharding: heads axis split over tp
+    shard_shape = kc2.sharding.shard_shape(kc2.shape)
+    assert shard_shape[2] == spec.n_kv_heads // 4
