@@ -1,0 +1,248 @@
+"""OpenAI-compatible HTTP API server.
+
+TPU-native counterpart of src/apps/dllama-api/dllama-api.cpp: `POST /v1/chat/completions`
+(streaming SSE via chunked transfer + non-streaming JSON), `GET /v1/models`, per-request
+temperature/seed/max_tokens/stop overrides (dllama-api.cpp:351-380), and the NaiveCache
+longest-prefix KV reuse (dllama-api.cpp:187-232) — reformulated over token ids: the engine
+keeps the previous conversation's KV; a new request reuses the longest common token
+prefix and rewinds `pos` instead of re-prefilling.
+
+Uses http.server (stdlib) with a generation lock — the reference is likewise a
+single-request-at-a-time accept loop (dllama-api.cpp:418-429). Batched concurrent serving
+is a capability extension tracked for a later round.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..runtime.engine import Engine
+from ..runtime.sampler import Sampler
+from ..tokenizer import ChatItem, ChatTemplate, EosDetector, EosResult, TemplateType
+
+
+class NaiveCache:
+    """Longest-common-token-prefix KV reuse (NaiveCache, dllama-api.cpp:187-232)."""
+
+    def __init__(self):
+        self.tokens: list[int] = []
+
+    def resolve(self, prompt: list[int]) -> int:
+        """Return number of leading prompt tokens already in the KV cache."""
+        n = 0
+        for a, b in zip(self.tokens, prompt):
+            if a != b:
+                break
+            n += 1
+        # never reuse the full prompt — the last token must be re-inferred for logits
+        return min(n, max(len(prompt) - 1, 0))
+
+    def update(self, tokens: list[int]) -> None:
+        self.tokens = list(tokens)
+
+
+class ApiState:
+    def __init__(self, engine: Engine, template_type: TemplateType,
+                 default_sampler: Sampler):
+        self.engine = engine
+        self.lock = threading.Lock()
+        self.cache = NaiveCache()
+        tok = engine.tokenizer
+        self.template = ChatTemplate(template_type, tok.chat_template, tok.eos_piece())
+        self.default_sampler = default_sampler
+        self.model_name = "distributed-llama-tpu"
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def _completion_payload(state: ApiState, text: str, finish: str) -> dict:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+        "object": "chat.completion",
+        "created": _now(),
+        "model": state.model_name,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish,
+        }],
+    }
+
+
+def _chunk_payload(state: ApiState, delta: dict, finish: str | None) -> dict:
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+        "object": "chat.completion.chunk",
+        "created": _now(),
+        "model": state.model_name,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+    }
+
+
+def run_completion(state: ApiState, body: dict, emit):
+    """Shared completion core. `emit(text_delta)` streams; returns (text, finish)."""
+    engine, tok = state.engine, state.engine.tokenizer
+    messages = [ChatItem(m.get("role", "user"), m.get("content", ""))
+                for m in body.get("messages", [])]
+    rendered = state.template.generate(messages)
+    prompt = tok.encode(rendered, add_bos=True)
+
+    sampler = Sampler(
+        engine.spec.vocab_size,
+        float(body.get("temperature", state.default_sampler.temperature)),
+        float(body.get("top_p", state.default_sampler.topp)),
+        int(body.get("seed", _now())),
+    )
+    max_tokens = int(body.get("max_tokens", 0)) or (engine.spec.seq_len - len(prompt))
+
+    stops = tok.chat_stops()
+    for s in body.get("stop", []) or []:
+        stops.append(s.encode())
+    detector = EosDetector(tok.chat_eos_id, stops, padding_left=2, padding_right=2)
+
+    # NaiveCache prefix reuse: rewind pos to the common token prefix
+    reuse = state.cache.resolve(prompt)
+    engine.pos = reuse
+    delta_prompt = prompt[reuse:]
+
+    pieces: list[str] = []
+    stopped = [False]
+    finish = ["length"]
+
+    def on_token(t):
+        res = detector.append(t, tok.decode_piece(0, t))
+        if res == EosResult.NOT_EOS:
+            d = detector.get_delta()
+            if d:
+                text = d.decode("utf-8", errors="replace")
+                pieces.append(text)
+                emit(text)
+            detector.clear()
+        elif res == EosResult.EOS:
+            d = detector.get_delta()
+            if d:
+                text = d.decode("utf-8", errors="replace")
+                pieces.append(text)
+                emit(text)
+            stopped[0] = True
+            finish[0] = "stop"
+
+    out, _stats = engine.generate(delta_prompt, max_tokens, sampler,
+                                  on_token=on_token, stop_check=lambda t: stopped[0])
+    # only tokens whose KV was actually written are reusable (a final stop token is
+    # sampled but never inferred, so engine.pos may be one short of prompt+out)
+    state.cache.update((prompt + out)[: engine.pos])
+    return "".join(pieces), finish[0]
+
+
+class Handler(BaseHTTPRequestHandler):
+    state: ApiState  # injected
+
+    def log_message(self, fmt, *args):  # quieter logs, reference prints per request
+        print(f"🔷 {self.command} {self.path}")
+
+    def _json(self, code: int, payload: dict):
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/v1/models":
+            self._json(200, {"object": "list", "data": [
+                {"id": self.state.model_name, "object": "model",
+                 "created": _now(), "owned_by": "user"}]})
+        elif self.path in ("/health", "/healthz"):
+            self._json(200, {"status": "ok"})
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path not in ("/v1/chat/completions", "/chat/completions"):
+            self._json(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._json(400, {"error": "invalid JSON body"})
+            return
+        if not isinstance(body.get("messages"), list) or not body["messages"]:
+            self._json(400, {"error": "messages[] required"})
+            return
+        stream = bool(body.get("stream", False))
+        state = self.state
+        with state.lock:
+            if stream:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def emit(text):
+                    payload = _chunk_payload(state, {"content": text}, None)
+                    self._write_chunk(f"data: {json.dumps(payload)}\n\n".encode())
+
+                try:
+                    _text, finish = run_completion(state, body, emit)
+                    self._write_chunk(
+                        ("data: " + json.dumps(_chunk_payload(state, {}, finish))
+                         + "\n\n").encode())
+                except Exception as e:  # headers already sent: error as SSE event
+                    self._write_chunk(
+                        f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+                finally:
+                    # always terminate the chunked stream so clients don't hang
+                    self._write_chunk(b"data: [DONE]\n\n")
+                    self._write_chunk(b"")
+            else:
+                try:
+                    text, finish = run_completion(state, body, lambda _t: None)
+                    self._json(200, _completion_payload(state, text, finish))
+                except ValueError as e:
+                    self._json(400, {"error": str(e)})
+                except Exception as e:
+                    self._json(500, {"error": str(e)})
+
+    def _write_chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+
+def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
+          template_type: TemplateType = TemplateType.UNKNOWN,
+          default_sampler: Sampler | None = None) -> ThreadingHTTPServer:
+    state = ApiState(engine, template_type,
+                     default_sampler or Sampler(engine.spec.vocab_size, 0.7, 0.9, 0))
+    handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
+    server = ThreadingHTTPServer((host, port), handler)
+    print(f"🟢 dllama-api listening on {host}:{port}")
+    return server
+
+
+def main(argv=None) -> None:
+    from .dllama import build_parser, make_engine, make_sampler
+
+    p = build_parser(include_mode=False)
+    p.add_argument("--port", type=int, default=9990)
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+    engine = make_engine(args)
+    sampler = make_sampler(args, engine.spec)
+    server = serve(engine, args.host, args.port,
+                   TemplateType(args.chat_template) if args.chat_template
+                   else TemplateType.UNKNOWN, sampler)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

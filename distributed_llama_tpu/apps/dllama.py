@@ -1,0 +1,191 @@
+"""dllama CLI — benchmark / generate / chat modes.
+
+TPU-native counterpart of src/apps/dllama/dllama.cpp. The reference's `worker` mode
+(dllama.cpp:205-221) has no equivalent: worker processes are replaced by SPMD shards of
+one program, so a "worker" is just a mesh device. `--workers host:port` becomes `--tp N`;
+`--nthreads` is meaningless (XLA owns the chip) and accepted-but-ignored for CLI
+compatibility.
+
+Modes (dllama.cpp:230-245):
+    inference  — run prompt + --steps tokens, print per-token G/I/T-style stats
+    generate   — stream tokens until EOS or --steps
+    chat       — interactive REPL with chat template + stop detection (dllama.cpp:111-194)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..models.spec import ModelSpec
+from ..quants import FloatType
+from ..runtime.engine import Engine, GenerationStats
+from ..runtime.sampler import Sampler
+from ..tokenizer import ChatItem, ChatTemplate, EosDetector, EosResult, TemplateType
+
+
+def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dllama", description=__doc__)
+    if include_mode:
+        p.add_argument("mode", choices=["inference", "generate", "chat"])
+    p.add_argument("--model", required=True)
+    p.add_argument("--tokenizer", required=True)
+    p.add_argument("--prompt", default=None)
+    p.add_argument("--steps", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--topp", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--chat-template", default=None,
+                   choices=[t.value for t in TemplateType])
+    p.add_argument("--max-seq-len", type=int, default=0)
+    p.add_argument("--weights-float-type", default=None,
+                   choices=["f32", "f16", "q40", "q80"])
+    p.add_argument("--buffer-float-type", default="q80",
+                   choices=["f32", "f16", "q40", "q80"],
+                   help="q80 enables int8-compressed collectives (wire compression)")
+    p.add_argument("--tp", type=int, default=None, help="tensor-parallel devices")
+    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--no-pallas", action="store_true")
+    p.add_argument("--nthreads", type=int, default=None, help="ignored (XLA owns the chip)")
+    p.add_argument("--kv-cache-storage", default=None, help="ignored (KV lives in HBM)")
+    return p
+
+
+_FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
+       "q80": FloatType.Q80}
+
+
+def make_engine(args) -> Engine:
+    import jax.numpy as jnp
+    import time
+
+    t0 = time.perf_counter()
+    engine = Engine.load(
+        args.model, args.tokenizer, max_seq_len=args.max_seq_len,
+        weights_ftype=_FT[args.weights_float_type] if args.weights_float_type else None,
+        tp=args.tp,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        use_pallas=False if args.no_pallas else None,
+        compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1,
+    )
+    print(f"⏩ Loaded model in {time.perf_counter() - t0:.1f}s "
+          f"(tp={engine.tp}, pallas={engine.use_pallas})")
+    spec = engine.spec
+    for k in ("dim", "hidden_dim", "n_layers", "n_heads", "n_kv_heads", "vocab_size",
+              "seq_len"):
+        print(f"💡 {k}: {getattr(spec, k)}")
+    return engine
+
+
+def make_sampler(args, spec: ModelSpec) -> Sampler:
+    import time
+
+    seed = args.seed if args.seed is not None else int(time.time())
+    return Sampler(spec.vocab_size, args.temperature, args.topp, seed)
+
+
+def mode_inference(args) -> None:
+    engine = make_engine(args)
+    sampler = make_sampler(args, engine.spec)
+    tok = engine.tokenizer
+    prompt = tok.encode(args.prompt or "Hello world", add_bos=True)
+    pieces: list[bytes] = []
+
+    def on_token(t):
+        piece = tok.decode_piece(prompt[-1] if not pieces else 0, t)
+        pieces.append(piece)
+
+    out, stats = engine.generate(prompt, args.steps, sampler, on_token=on_token)
+    text = b"".join(pieces).decode("utf-8", errors="replace")
+    print(text)
+    # per-token stats table like dllama.cpp:76-93
+    for i, (g, inf) in enumerate(zip(stats.token_ms, stats.infer_ms)):
+        print(f"🔶 G {g:7.2f} ms I {inf:7.2f} ms T {g - inf:7.2f} ms "
+              f"S {stats.sent_kbytes_per_token:8.0f} kB R {stats.recv_kbytes_per_token:8.0f} kB {pieces[i].decode('utf-8', 'replace')}")
+    print(f"Generated tokens:    {stats.generated_tokens}")
+    print(f"Avg tokens / second: {stats.tokens_per_second:.2f}")
+    print(f"Avg generation time: {stats.avg_token_ms:.2f} ms")
+    print(f"Avg inference time:  {stats.avg_infer_ms:.2f} ms")
+    print(f"Prefill time:        {stats.prefill_ms:.2f} ms "
+          f"({stats.prompt_tokens} tokens)")
+
+
+def mode_generate(args) -> None:
+    engine = make_engine(args)
+    sampler = make_sampler(args, engine.spec)
+    tok = engine.tokenizer
+    prompt = tok.encode(args.prompt or "", add_bos=True)
+    prev = prompt[-1] if prompt else -1
+
+    def on_token(t):
+        nonlocal prev
+        sys.stdout.buffer.write(tok.decode_piece(prev, t))
+        sys.stdout.flush()
+        prev = t
+
+    engine.generate(prompt, args.steps, sampler, on_token=on_token,
+                    stop_check=lambda t: t == tok.eos_id)
+    print()
+
+
+def mode_chat(args) -> None:
+    """Interactive REPL (Chat::chat, dllama.cpp:132-193): KV position persists across
+    turns; generation stops on chat EOS or stop strings."""
+    engine = make_engine(args)
+    sampler = make_sampler(args, engine.spec)
+    tok = engine.tokenizer
+    template = ChatTemplate(args.chat_template or TemplateType.UNKNOWN,
+                            tok.chat_template, tok.eos_piece())
+    stops = tok.chat_stops()
+
+    print("💻 System prompt (optional): ", end="", flush=True)
+    system = sys.stdin.readline().strip()
+    first = True
+    while True:
+        print("\n👱 User\n> ", end="", flush=True)
+        user = sys.stdin.readline()
+        if not user:
+            break
+        items = []
+        if first and system:
+            items.append(ChatItem("system", system))
+        items.append(ChatItem("user", user.strip()))
+        rendered = template.generate(items)
+        prompt = tok.encode(rendered, add_bos=first)
+        first = False
+
+        print("\n🤖 Assistant\n", flush=True)
+        detector = EosDetector(tok.chat_eos_id, stops,
+                               padding_left=2, padding_right=2)
+        stopped = False
+
+        def on_token(t):
+            nonlocal stopped
+            res = detector.append(t, tok.decode_piece(0, t))
+            if res == EosResult.NOT_EOS:
+                delta = detector.get_delta()
+                if delta:
+                    sys.stdout.buffer.write(delta)
+                    sys.stdout.flush()
+                detector.clear()
+            elif res == EosResult.EOS:
+                delta = detector.get_delta()
+                if delta:
+                    sys.stdout.buffer.write(delta)
+                    sys.stdout.flush()
+                stopped = True
+
+        engine.generate(prompt, engine.spec.seq_len - engine.pos - 1, sampler,
+                        on_token=on_token, stop_check=lambda t: stopped)
+        if engine.pos >= engine.spec.seq_len - 1:
+            print("\n(context end reached)")
+            break
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    {"inference": mode_inference, "generate": mode_generate, "chat": mode_chat}[args.mode](args)
+
+
+if __name__ == "__main__":
+    main()

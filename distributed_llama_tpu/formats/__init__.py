@@ -1,0 +1,2 @@
+from .mfile import load_model, write_model  # noqa: F401
+from .tfile import TokenizerData, load_tokenizer, write_tokenizer  # noqa: F401

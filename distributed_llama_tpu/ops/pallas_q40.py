@@ -55,9 +55,11 @@ def _q40_matmul_2d(x, packed2, scales, *, block_n: int = 512, interpret: bool = 
     n, k2 = packed2.shape
     nb = scales.shape[-1]
     assert k2 * 2 == k and nb * QK == k, (packed2.shape, x.shape, scales.shape)
-    bn = block_n
-    while n % bn:
-        bn //= 2
+    # largest divisor of n that is a multiple of 8 and <= block_n (Mosaic needs the
+    # sublane block divisible by 8 unless it spans the whole axis); tiny/odd n falls
+    # back to a single whole-array block
+    start = min(block_n, n) // 8 * 8
+    bn = next((b for b in range(start, 7, -8) if n % b == 0), n)
     x_perm = permute_activations_tpu(x, nb)
 
     return pl.pallas_call(

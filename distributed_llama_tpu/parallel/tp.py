@@ -33,7 +33,8 @@ def _expand_pspec_tree(params: dict[str, Any], pspecs: dict[str, Any]):
             out[k] = _expand_pspec_tree(v, pspecs[k])
         elif isinstance(v, QTensor):
             spec = pspecs[k]
-            out[k] = QTensor(v.ftype, spec, spec if v.scales is not None else None)
+            out[k] = QTensor(v.ftype, spec, spec if v.scales is not None else None,
+                             layout=v.layout)
         else:
             out[k] = pspecs[k]
     return out

@@ -1,0 +1,2 @@
+from .sampler import Sampler  # noqa: F401
+from .engine import Engine, GenerationStats  # noqa: F401

@@ -1,0 +1,192 @@
+"""Inference engine: model loading, SPMD step compilation, generation loop, stats.
+
+This is the TPU-native replacement for the reference's App::run wiring + Inference/Worker
+drivers (src/app.cpp:123-155, src/tasks.cpp:158-230):
+
+    SocketPool::connect + worker processes  ->  jax.sharding.Mesh over local TPU devices
+    Transformer::loadRootFromFile + weight streaming -> formats.load_model + shard_params
+    Inference::infer (per-token task loop)  ->  one jitted SPMD step, KV caches donated
+    tryWaitForPos / sendPos                 ->  gone (start_pos is a step argument)
+    Inference::getStats I/T split           ->  GenerationStats (device step wall time +
+                                                analytic collective-bytes model, since
+                                                ICI transfer overlaps compute under XLA)
+
+Prefill runs in chunks of [64, 8, 1] tokens (3 compiled shapes) — the reference prefills
+strictly token-by-token (dllama.cpp:163-167), so chunked prefill is a capability win.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.forward import forward, init_kv_cache
+from ..models.params import Params, prepare_for_pallas
+from ..models.spec import ModelSpec
+from ..ops.rope import RopeTables
+from ..parallel.mesh import AXIS_TP, make_mesh
+from ..parallel.tp import make_sharded_forward, shard_params
+from ..quants import FloatType
+from ..tokenizer.bpe import Tokenizer
+
+PREFILL_CHUNKS = (64, 8, 1)
+
+
+@dataclass
+class GenerationStats:
+    """Per-token timing + traffic, the analog of the reference's G/I/T + S/R printout
+    (dllama.cpp:76-93, socket.cpp:280-285)."""
+
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    prefill_ms: float = 0.0
+    token_ms: list[float] = field(default_factory=list)
+    infer_ms: list[float] = field(default_factory=list)
+    sent_kbytes_per_token: float = 0.0  # analytic ICI traffic model
+    recv_kbytes_per_token: float = 0.0
+
+    @property
+    def avg_token_ms(self) -> float:
+        return float(np.mean(self.token_ms)) if self.token_ms else 0.0
+
+    @property
+    def avg_infer_ms(self) -> float:
+        return float(np.mean(self.infer_ms)) if self.infer_ms else 0.0
+
+    @property
+    def tokens_per_second(self) -> float:
+        return 1000.0 / self.avg_token_ms if self.token_ms else 0.0
+
+
+def collective_kbytes_per_token(spec: ModelSpec, tp: int, compress: bool) -> float:
+    """Bytes each device exchanges per decoded token (all-reduce modeled as 2x(tp-1)/tp
+    of payload out + in). Mirrors the reference's S/R socket counters, which measured the
+    root's broadcast+gather per layer (tasks.cpp:44-94)."""
+    if tp <= 1:
+        return 0.0
+    elem = 34 / 32 if compress else 4  # Q80 bytes/elem vs f32
+    per_layer = 2 * spec.dim * elem  # attention-out psum + ffn-out psum payloads
+    logits = (spec.vocab_size // tp) * 4
+    payload = spec.n_layers * per_layer + logits
+    return 2 * (tp - 1) / tp * payload / 1024.0
+
+
+class Engine:
+    def __init__(self, spec: ModelSpec, params: Params, tokenizer: Tokenizer | None = None,
+                 *, tp: int | None = None, dtype=jnp.float32, use_pallas: bool | None = None,
+                 compress_collectives: bool = False, batch: int = 1):
+        self.spec = spec
+        self.tokenizer = tokenizer
+        self.dtype = dtype
+        self.compress = compress_collectives
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        self.mesh = make_mesh(tp=tp)
+        self.tp = self.mesh.shape[AXIS_TP]
+        has_q40 = any(
+            getattr(t, "ftype", None) == FloatType.Q40
+            for t in params["blocks"].values())
+        self.use_pallas = use_pallas and has_q40
+        if self.use_pallas:
+            params = prepare_for_pallas(params, self.tp)
+        self.params = shard_params(params, self.mesh, spec)
+        self.rope = RopeTables.create(spec)
+        self.batch = batch
+        self._step = make_sharded_forward(
+            spec, self.mesh, self.params, dtype=dtype, use_pallas=self.use_pallas,
+            compress_collectives=compress_collectives, donate_cache=True)
+        self.k_cache, self.v_cache = self._init_cache()
+        self.pos = 0
+
+    @classmethod
+    def load(cls, model_path: str, tokenizer_path: str | None = None, *,
+             max_seq_len: int = 0, weights_ftype: FloatType | None = None,
+             **kw) -> "Engine":
+        from ..formats.mfile import load_model
+
+        spec, params = load_model(model_path, max_seq_len, weights_ftype)
+        tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
+        if tokenizer is not None and tokenizer.vocab_size != spec.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {tokenizer.vocab_size} != model vocab {spec.vocab_size}")
+        return cls(spec, params, tokenizer, **kw)
+
+    def _init_cache(self):
+        kc, vc = init_kv_cache(self.spec, batch=self.batch, dtype=self.dtype)
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import kv_cache_pspec
+
+        sh = NamedSharding(self.mesh, kv_cache_pspec())
+        return jax.device_put(kc, sh), jax.device_put(vc, sh)
+
+    def reset(self) -> None:
+        self.pos = 0
+
+    # ------------------------------------------------------------------
+    # core stepping
+    # ------------------------------------------------------------------
+
+    def infer_chunk(self, tokens: list[int] | np.ndarray) -> np.ndarray:
+        """Run a chunk of tokens at the current position; returns last-token logits
+        (vocab,) and advances pos. Bounds-checked against seq_len (the reference hard-stops
+        at context end, dllama.cpp:190-192)."""
+        tokens = np.asarray(tokens, dtype=np.int32)
+        t = len(tokens)
+        if self.pos + t > self.spec.seq_len:
+            raise ValueError(f"context overflow: pos {self.pos} + {t} > {self.spec.seq_len}")
+        logits, self.k_cache, self.v_cache = self._step(
+            self.params, self.rope, jnp.asarray(tokens)[None, :], self.k_cache,
+            self.v_cache, jnp.int32(self.pos))
+        self.pos += t
+        return np.asarray(logits)[0, -1]
+
+    def prefill(self, tokens: list[int], stats: GenerationStats | None = None) -> np.ndarray:
+        """Chunked prompt ingestion; returns logits after the last prompt token."""
+        t0 = time.perf_counter()
+        tokens = list(tokens)
+        logits = None
+        i = 0
+        while i < len(tokens):
+            for chunk in PREFILL_CHUNKS:
+                if len(tokens) - i >= chunk:
+                    logits = self.infer_chunk(tokens[i:i + chunk])
+                    i += chunk
+                    break
+        if stats is not None:
+            stats.prefill_ms = (time.perf_counter() - t0) * 1000.0
+            stats.prompt_tokens = len(tokens)
+        return logits
+
+    def generate(self, prompt_tokens: list[int], max_tokens: int, sampler,
+                 on_token=None, stop_check=None) -> tuple[list[int], GenerationStats]:
+        """Host generation loop: prefill + sample/step until max_tokens, context end, or
+        stop_check truth. on_token(token_id) streams tokens out."""
+        stats = GenerationStats()
+        stats.sent_kbytes_per_token = stats.recv_kbytes_per_token = (
+            collective_kbytes_per_token(self.spec, self.tp, self.compress))
+        logits = self.prefill(prompt_tokens, stats)
+        out: list[int] = []
+        for _ in range(max_tokens):
+            if self.pos >= self.spec.seq_len:
+                break
+            t0 = time.perf_counter()
+            token = sampler.sample(logits)
+            out.append(token)
+            stats.generated_tokens += 1
+            if on_token is not None:
+                on_token(token)
+            if stop_check is not None and stop_check(token):
+                break
+            if self.pos >= self.spec.seq_len:
+                break
+            t1 = time.perf_counter()
+            logits = self.infer_chunk([token])
+            t2 = time.perf_counter()
+            stats.infer_ms.append((t2 - t1) * 1000.0)
+            stats.token_ms.append((t2 - t0) * 1000.0)
+        return out, stats

@@ -1,0 +1,145 @@
+"""File-format tests: .m/.t round trips + byte compatibility with the reference writer."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.formats.mfile import (
+    load_model,
+    params_file_order,
+    read_spec,
+    write_model,
+)
+from distributed_llama_tpu.formats.tfile import TokenizerData, load_tokenizer, write_tokenizer
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec, RopeType
+from distributed_llama_tpu.quants import FloatType
+
+
+def tiny_spec(arch=ArchType.LLAMA, **kw):
+    d = dict(arch_type=arch, dim=64, hidden_dim=96, n_layers=2, n_heads=4, n_kv_heads=2,
+             vocab_size=128, seq_len=32, rope_theta=10000.0)
+    if arch != ArchType.LLAMA:
+        d.update(n_experts=4, n_active_experts=2)
+    if arch == ArchType.GROK1:
+        d.update(hidden_act=HiddenAct.GELU)
+    d.update(kw)
+    return ModelSpec(**d).resolved()
+
+
+@pytest.mark.parametrize("arch", [ArchType.LLAMA, ArchType.MIXTRAL, ArchType.GROK1])
+@pytest.mark.parametrize("ftype", [FloatType.F32, FloatType.Q40])
+def test_mfile_roundtrip(tmp_path, arch, ftype):
+    spec = tiny_spec(arch)
+    params = init_random_params(spec, ftype, seed=1)
+    path = str(tmp_path / "model.m")
+    write_model(path, spec, params_file_order(spec, params), ftype)
+
+    spec2, params2 = load_model(path)
+    assert spec2.arch_type == spec.arch_type
+    assert (spec2.dim, spec2.hidden_dim, spec2.n_layers) == (spec.dim, spec.hidden_dim,
+                                                             spec.n_layers)
+    assert (spec2.n_experts, spec2.n_active_experts) == (spec.n_experts,
+                                                         spec.n_active_experts)
+    assert spec2.hidden_act == spec.hidden_act
+    # tensors survive (through one quantization round for quantized types)
+    np.testing.assert_allclose(params2["embedding"], params["embedding"], atol=1e-6)
+    for name in params["blocks"]:
+        a, b = params["blocks"][name], params2["blocks"][name]
+        a = a.to_numpy() if hasattr(a, "to_numpy") else np.asarray(a)
+        b = b.to_numpy() if hasattr(b, "to_numpy") else np.asarray(b)
+        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=name)
+
+
+def test_mfile_seq_len_clamp(tmp_path):
+    spec = tiny_spec()
+    params = init_random_params(spec, FloatType.F32, seed=2)
+    path = str(tmp_path / "m.m")
+    write_model(path, spec, params_file_order(spec, params), FloatType.F32)
+    spec2, _, _ = read_spec(path, max_seq_len=8)
+    assert spec2.seq_len == 8 and spec2.orig_seq_len == 32
+
+
+def test_mfile_wrong_ftype_detected(tmp_path):
+    spec = tiny_spec()
+    params = init_random_params(spec, FloatType.Q40, seed=3)
+    path = str(tmp_path / "m.m")
+    write_model(path, spec, params_file_order(spec, params), FloatType.Q40)
+    with pytest.raises(ValueError, match="mismatch"):
+        load_model(path, weights_ftype=FloatType.F32)
+
+
+def test_mfile_reference_writer_compatibility(tmp_path):
+    """A file produced by the REFERENCE converter's writer must load identically.
+
+    Runs /root/reference/converter/writer.py (public untrusted code, used here only as a
+    byte-format oracle) to build a tiny llama .m file.
+    """
+    torch = pytest.importorskip("torch")
+    import sys
+
+    sys.path.insert(0, "/root/reference/converter")
+    import writer as refwriter  # noqa
+
+    spec = tiny_spec()
+    params = init_random_params(spec, FloatType.Q40, seed=4)
+    path = str(tmp_path / "ref.m")
+    with open(path, "wb") as f:
+        refwriter.writeHeader(f, {
+            "version": 0, "arch_type": int(spec.arch_type), "dim": spec.dim,
+            "hidden_dim": spec.hidden_dim, "n_layers": spec.n_layers,
+            "n_heads": spec.n_heads, "n_kv_heads": spec.n_kv_heads,
+            "n_experts": 0, "n_active_experts": 0, "vocab_size": spec.vocab_size,
+            "max_seq_len": spec.seq_len, "hidden_act": int(spec.hidden_act),
+            "rope_theta": int(spec.rope_theta),
+            "weights_float_type": int(FloatType.Q40),
+        })
+        norm_names = {"embedding", "rms_att", "rms_ffn", "rms_final"}
+        for name, tensor in params_file_order(spec, params):
+            ft = refwriter.FloatType.F32 if name in norm_names else refwriter.FloatType.Q40
+            refwriter.writeTensor(f, torch.from_numpy(np.ascontiguousarray(tensor)), ft)
+
+    spec2, params2 = load_model(path)
+    assert spec2.dim == spec.dim and spec2.arch_type == ArchType.LLAMA
+    np.testing.assert_allclose(params2["embedding"], params["embedding"], atol=1e-6)
+    np.testing.assert_allclose(params2["blocks"]["wq"].to_numpy(),
+                               params["blocks"]["wq"].to_numpy(), atol=1e-6)
+    np.testing.assert_allclose(params2["wcls"].to_numpy(), params["wcls"].to_numpy(),
+                               atol=1e-6)
+
+
+def test_tfile_roundtrip(tmp_path):
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(32, 60)]
+    td = TokenizerData(vocab=vocab, scores=[float(-i) for i in range(len(vocab))],
+                       bos_id=1, eos_id=2, chat_eos_id=2, max_token_length=6,
+                       chat_template="{% if %}<|im_start|>{% endif %}", chat_stop="<|done|>")
+    path = str(tmp_path / "tok.t")
+    write_tokenizer(path, td)
+    td2 = load_tokenizer(path)
+    assert td2.vocab == vocab
+    assert td2.scores == td.scores
+    assert (td2.bos_id, td2.eos_id, td2.chat_eos_id) == (1, 2, 2)
+    assert td2.chat_template == td.chat_template
+    assert td2.chat_stop == td.chat_stop
+
+
+def test_tfile_reference_writer_compatibility(tmp_path):
+    import sys
+
+    sys.path.insert(0, "/root/reference/converter")
+    import importlib
+
+    reftw = importlib.import_module("tokenizer-writer")
+
+    vocab = [b"<unk>", b"<s>", b"</s>", b"ab", b"cd"]
+    scores = [0.0, 0.0, 0.0, -1.0, -2.0]
+    path = str(tmp_path / "ref.t")
+    with open(path, "wb") as f:
+        reftw.writeTokenizer(f, {"bos_id": 1, "eos_id": 2, "chat_eos_id": 2},
+                             vocab, scores, b"<|im_start|>x", None)
+    td = load_tokenizer(path)
+    assert td.vocab == vocab
+    assert td.bos_id == 1 and td.eos_id == 2
+    assert td.chat_template == "<|im_start|>x"
