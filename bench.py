@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Benchmark: Llama-2-7B-shaped Q40 single-chip decode throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best published single-node Llama-2-7B number — 101.81 ms/token
+(9.82 tok/s) on a GCP c3d-highcpu-30 VM (reference README.md:129-131, BASELINE.md).
+vs_baseline > 1.0 means this framework on one TPU chip beats that.
+
+Weights are synthesized directly on device in the Pallas kernel's Q40 layout (random
+nibbles + scales) — decode cost is layout/bandwidth-bound and independent of weight
+values, so this measures exactly what a converted checkpoint would.
+
+Usage: python bench.py [--small] [--steps N] [--tp N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+
+# sitecustomize imports jax before this script runs, freezing the platform choice;
+# honor an explicit JAX_PLATFORMS from the caller (e.g. cpu CI smoke runs)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from distributed_llama_tpu.models.forward import init_kv_cache  # noqa: E402
+from distributed_llama_tpu.models.params import _COL_PARALLEL, block_tensor_shapes  # noqa: E402
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType  # noqa: E402
+from distributed_llama_tpu.ops.rope import RopeTables  # noqa: E402
+from distributed_llama_tpu.parallel.mesh import AXIS_TP, make_mesh  # noqa: E402
+from distributed_llama_tpu.parallel.tp import make_sharded_forward, shard_params  # noqa: E402
+from distributed_llama_tpu.quants import QK, FloatType, QTensor  # noqa: E402
+
+BASELINE_TOK_S = 1000.0 / 101.81  # Llama-2-7B, 1x GCP c3d VM (reference README.md:131)
+
+LLAMA2_7B = dict(arch_type=ArchType.LLAMA, dim=4096, hidden_dim=11008, n_layers=32,
+                 n_heads=32, n_kv_heads=32, vocab_size=32000, seq_len=2048,
+                 rope_type=RopeType.LLAMA)
+SMALL = dict(arch_type=ArchType.LLAMA, dim=512, hidden_dim=1408, n_layers=4,
+             n_heads=8, n_kv_heads=8, vocab_size=32000, seq_len=256,
+             rope_type=RopeType.LLAMA)
+
+
+def synth_q40(key, shape, on_tpu: bool):
+    """Random Q40 tensor synthesized on device, already in the kernel's layout."""
+    out, in_ = shape[-2], shape[-1]
+    lead = shape[:-2]
+    k1, k2 = jax.random.split(key)
+    scales = (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
+              + 0.001)
+    if on_tpu:
+        packed = jax.random.randint(k1, (*lead, out, in_ // 2), 0, 256, jnp.uint8)
+        return QTensor(FloatType.Q40, packed, scales, layout="tpu")
+    packed = jax.random.randint(k1, (*lead, out, in_ // QK, 16), 0, 256, jnp.uint8)
+    return QTensor(FloatType.Q40, packed, scales.astype(jnp.float16))
+
+
+def synth_params(spec: ModelSpec, on_tpu: bool):
+    key = jax.random.PRNGKey(0)
+    blocks = {}
+    for name, (shape, quantized) in block_tensor_shapes(spec).items():
+        key, sub = jax.random.split(key)
+        full = (spec.n_layers, *shape)
+        if quantized:
+            blocks[name] = synth_q40(sub, full, on_tpu)
+        else:
+            blocks[name] = jnp.ones(full, jnp.float32)
+    key, k1, k2 = jax.random.split(key, 3)
+    return {
+        "embedding": jax.random.normal(k1, (spec.vocab_size, spec.dim), jnp.float32) * 0.02,
+        "blocks": blocks,
+        "rms_final": jnp.ones((spec.dim,), jnp.float32),
+        "wcls": synth_q40(k2, (spec.vocab_size, spec.dim), on_tpu),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true", help="tiny model (CI smoke)")
+    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--tp", type=int, default=1)
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    spec = ModelSpec(**(SMALL if args.small else LLAMA2_7B)).resolved()
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    mesh = make_mesh(tp=args.tp)
+    params = synth_params(spec, on_tpu)
+    params = shard_params(params, mesh, spec)
+    rope = RopeTables.create(spec)
+    step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
+                                donate_cache=True)
+    kc, vc = init_kv_cache(spec, dtype=dtype)
+
+    tok = jnp.asarray([[1]], jnp.int32)
+    logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile + warm
+    logits.block_until_ready()
+    for i in range(3):  # warm steps
+        logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(1 + i))
+    logits.block_until_ready()
+
+    t0 = time.perf_counter()
+    pos = 4
+    for _ in range(args.steps):
+        logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(pos))
+        pos += 1
+    logits.block_until_ready()
+    dt = (time.perf_counter() - t0) / args.steps
+
+    tok_s = 1.0 / dt
+    name = "llama2_7b_q40_decode_tok_s" if not args.small else "small_q40_decode_tok_s"
+    print(json.dumps({
+        "metric": name,
+        "value": round(tok_s, 3),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..runtime.engine import Engine
 from ..runtime.sampler import Sampler
-from ..tokenizer import ChatItem, ChatTemplate, EosDetector, EosResult, TemplateType
+from ..tokenizer import ChatItem, ChatTemplate, EosDetector, TemplateType
+from ..tokenizer.eos import TokenStreamer
 
 
 class NaiveCache:
@@ -75,14 +76,22 @@ def _completion_payload(state: ApiState, text: str, finish: str) -> dict:
     }
 
 
-def _chunk_payload(state: ApiState, delta: dict, finish: str | None) -> dict:
+def _chunk_payload(state: ApiState, completion_id: str, delta: dict,
+                   finish: str | None) -> dict:
+    # one id across all chunks of a completion, per the OpenAI streaming contract
     return {
-        "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+        "id": completion_id,
         "object": "chat.completion.chunk",
         "created": _now(),
         "model": state.model_name,
         "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
     }
+
+
+def _opt(body: dict, key: str, default):
+    """Request override with OpenAI null semantics: explicit null == unset."""
+    v = body.get(key)
+    return default if v is None else v
 
 
 def run_completion(state: ApiState, body: dict, emit):
@@ -95,15 +104,17 @@ def run_completion(state: ApiState, body: dict, emit):
 
     sampler = Sampler(
         engine.spec.vocab_size,
-        float(body.get("temperature", state.default_sampler.temperature)),
-        float(body.get("top_p", state.default_sampler.topp)),
-        int(body.get("seed", _now())),
+        float(_opt(body, "temperature", state.default_sampler.temperature)),
+        float(_opt(body, "top_p", state.default_sampler.topp)),
+        int(_opt(body, "seed", _now())),
     )
-    max_tokens = int(body.get("max_tokens", 0)) or (engine.spec.seq_len - len(prompt))
+    max_tokens = int(_opt(body, "max_tokens", 0)) or (engine.spec.seq_len - len(prompt))
 
     stops = tok.chat_stops()
-    for s in body.get("stop", []) or []:
-        stops.append(s.encode())
+    stop_param = _opt(body, "stop", [])
+    if isinstance(stop_param, str):  # OpenAI allows string-or-array
+        stop_param = [stop_param]
+    stops.extend(s.encode() for s in stop_param)
     detector = EosDetector(tok.chat_eos_id, stops, padding_left=2, padding_right=2)
 
     # NaiveCache prefix reuse: rewind pos to the common token prefix
@@ -112,29 +123,25 @@ def run_completion(state: ApiState, body: dict, emit):
     delta_prompt = prompt[reuse:]
 
     pieces: list[str] = []
-    stopped = [False]
     finish = ["length"]
 
-    def on_token(t):
-        res = detector.append(t, tok.decode_piece(0, t))
-        if res == EosResult.NOT_EOS:
-            d = detector.get_delta()
-            if d:
-                text = d.decode("utf-8", errors="replace")
-                pieces.append(text)
-                emit(text)
-            detector.clear()
-        elif res == EosResult.EOS:
-            d = detector.get_delta()
-            if d:
-                text = d.decode("utf-8", errors="replace")
-                pieces.append(text)
-                emit(text)
-            stopped[0] = True
-            finish[0] = "stop"
+    def emit_bytes(d: bytes):
+        text = d.decode("utf-8", errors="replace")
+        pieces.append(text)
+        emit(text)
 
-    out, _stats = engine.generate(delta_prompt, max_tokens, sampler,
-                                  on_token=on_token, stop_check=lambda t: stopped[0])
+    streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
+
+    try:
+        out, _stats = engine.generate(delta_prompt, max_tokens, sampler,
+                                      on_token=streamer.on_token,
+                                      stop_check=streamer.stop_check)
+    except Exception:
+        # KV may hold a half-written new conversation; drop the reuse index entirely
+        state.cache.update([])
+        raise
+    if streamer.stopped:
+        finish[0] = "stop"
     # only tokens whose KV was actually written are reusable (a final stop token is
     # sampled but never inferred, so engine.pos may be one short of prompt+out)
     state.cache.update((prompt + out)[: engine.pos])
@@ -187,15 +194,17 @@ class Handler(BaseHTTPRequestHandler):
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                completion_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
 
                 def emit(text):
-                    payload = _chunk_payload(state, {"content": text}, None)
+                    payload = _chunk_payload(state, completion_id, {"content": text}, None)
                     self._write_chunk(f"data: {json.dumps(payload)}\n\n".encode())
 
                 try:
                     _text, finish = run_completion(state, body, emit)
                     self._write_chunk(
-                        ("data: " + json.dumps(_chunk_payload(state, {}, finish))
+                        ("data: " + json.dumps(
+                            _chunk_payload(state, completion_id, {}, finish))
                          + "\n\n").encode())
                 except Exception as e:  # headers already sent: error as SSE event
                     self._write_chunk(

@@ -21,7 +21,8 @@ from ..models.spec import ModelSpec
 from ..quants import FloatType
 from ..runtime.engine import Engine, GenerationStats
 from ..runtime.sampler import Sampler
-from ..tokenizer import ChatItem, ChatTemplate, EosDetector, EosResult, TemplateType
+from ..tokenizer import ChatItem, ChatTemplate, EosDetector, TemplateType
+from ..tokenizer.eos import TokenStreamer
 
 
 def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
@@ -157,26 +158,14 @@ def mode_chat(args) -> None:
         print("\n🤖 Assistant\n", flush=True)
         detector = EosDetector(tok.chat_eos_id, stops,
                                padding_left=2, padding_right=2)
-        stopped = False
 
-        def on_token(t):
-            nonlocal stopped
-            res = detector.append(t, tok.decode_piece(0, t))
-            if res == EosResult.NOT_EOS:
-                delta = detector.get_delta()
-                if delta:
-                    sys.stdout.buffer.write(delta)
-                    sys.stdout.flush()
-                detector.clear()
-            elif res == EosResult.EOS:
-                delta = detector.get_delta()
-                if delta:
-                    sys.stdout.buffer.write(delta)
-                    sys.stdout.flush()
-                stopped = True
+        def emit(delta: bytes):
+            sys.stdout.buffer.write(delta)
+            sys.stdout.flush()
 
+        streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit)
         engine.generate(prompt, engine.spec.seq_len - engine.pos - 1, sampler,
-                        on_token=on_token, stop_check=lambda t: stopped)
+                        on_token=streamer.on_token, stop_check=streamer.stop_check)
         if engine.pos >= engine.spec.seq_len - 1:
             print("\n(context end reached)")
             break

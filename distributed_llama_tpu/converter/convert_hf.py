@@ -1,0 +1,171 @@
+"""HF safetensors checkpoint -> `.m` converter (llama / mistral / mixtral / grok-class).
+
+Counterpart of the reference converter/convert-hf.py with the same tensor plan and Q/K
+rotary re-permutation (HF stores q/k in GPT-NeoX half-split order; the `.m` runtime uses
+Meta interleaved order — convert-hf.py:12-15), but:
+- streams tensor-by-tensor with numpy (no torch residency),
+- includes the Mixtral router tensor `block_sparse_moe.gate.weight`, which the reference
+  fork's plan omits (convert-hf.py:67-75) even though its own loader requires it
+  (transformer.cpp:505) — an upstream bug, fixed here,
+- supports tied embeddings (missing lm_head -> reuse embed_tokens).
+
+Usage: python -m distributed_llama_tpu.converter.convert_hf <model_dir> <q40|q80|f16|f32> [out.m]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from ..formats.mfile import write_header, write_tensor
+from ..models.spec import ArchType, HiddenAct, ModelSpec, RopeType
+from ..quants import FloatType
+
+FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
+      "q80": FloatType.Q80}
+
+
+def permute_rotary(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """NeoX half-split -> interleaved rotary layout (reference permute, convert-hf.py:12-15)."""
+    out_dim = w.shape[0]
+    return (w.reshape(n_heads, 2, out_dim // n_heads // 2, *w.shape[1:])
+            .swapaxes(1, 2).reshape(w.shape))
+
+
+def spec_from_config(config: dict, max_seq_len: int = 0) -> ModelSpec:
+    arch_map = {"llama": ArchType.LLAMA, "mistral": ArchType.LLAMA,
+                "mixtral": ArchType.MIXTRAL}
+    arch = arch_map.get(config["model_type"])
+    if arch is None:
+        raise ValueError(f"unsupported model_type {config['model_type']!r}")
+    act = {"gelu": HiddenAct.GELU, "silu": HiddenAct.SILU}[config.get("hidden_act", "silu")]
+    rs = config.get("rope_scaling") or {}
+    rope_type = RopeType.UNKNOWN
+    if rs:
+        rope_type = {"llama3": RopeType.LLAMA3_1}.get(rs.get("rope_type"))
+        if rope_type is None:
+            raise ValueError(f"unsupported rope scaling {rs.get('rope_type')!r}")
+    return ModelSpec(
+        arch_type=arch,
+        dim=config["hidden_size"],
+        hidden_dim=config["intermediate_size"],
+        n_layers=config["num_hidden_layers"],
+        n_heads=config["num_attention_heads"],
+        n_kv_heads=config.get("num_key_value_heads", config["num_attention_heads"]),
+        vocab_size=config["vocab_size"],
+        seq_len=max_seq_len or config["max_position_embeddings"],
+        n_experts=config.get("num_local_experts", 0),
+        n_active_experts=config.get("num_experts_per_tok", 0),
+        hidden_act=act,
+        rope_theta=float(config.get("rope_theta", 10000.0)),
+        rope_type=rope_type,
+        rope_scaling_factor=float(rs.get("factor", 0)),
+        rope_scaling_low_freq_factor=float(rs.get("low_freq_factor", 0)),
+        rope_scaling_high_freq_factor=float(rs.get("high_freq_factor", 0)),
+        rope_scaling_orig_max_seq_len=int(rs.get("original_max_position_embeddings", 0)),
+    )
+
+
+class HfCheckpoint:
+    """Lazy multi-file safetensors reader returning numpy arrays."""
+
+    def __init__(self, model_dir: str):
+        from safetensors import safe_open
+
+        self.files = sorted(
+            os.path.join(model_dir, f) for f in os.listdir(model_dir)
+            if f.endswith(".safetensors"))
+        if not self.files:
+            raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+        self._open = safe_open
+        self._handles: dict[str, object] = {}
+        self._index: dict[str, str] = {}
+        for path in self.files:
+            # framework="pt": HF checkpoints are commonly bf16, which numpy lacks
+            with safe_open(path, framework="pt") as f:
+                for key in f.keys():
+                    self._index[key] = path
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def get(self, key: str) -> np.ndarray:
+        path = self._index[key]
+        if path not in self._handles:
+            self._handles.clear()  # keep one file resident
+            self._handles[path] = self._open(path, framework="pt")
+        t = self._handles[path].get_tensor(key)
+        return t.float().numpy()
+
+
+def tensor_plan(spec: ModelSpec, ckpt: HfCheckpoint):
+    """Yield (name-in-.m-order, array) from HF names (plan: convert-hf.py:52-90)."""
+
+    def get(key, transform=None):
+        t = ckpt.get(key)
+        if t.dtype != np.float32:
+            t = t.astype(np.float32)
+        return transform(t) if transform else t
+
+    yield "embedding", get("model.embed_tokens.weight")
+    for l in range(spec.n_layers):
+        pre = f"model.layers.{l}"
+        yield "wq", get(f"{pre}.self_attn.q_proj.weight",
+                        lambda w: permute_rotary(w, spec.n_heads))
+        yield "wk", get(f"{pre}.self_attn.k_proj.weight",
+                        lambda w: permute_rotary(w, spec.n_kv_heads))
+        yield "wv", get(f"{pre}.self_attn.v_proj.weight")
+        yield "wo", get(f"{pre}.self_attn.o_proj.weight")
+        if spec.is_moe:
+            yield "router", get(f"{pre}.block_sparse_moe.gate.weight")
+            for e in range(spec.n_experts):
+                ep = f"{pre}.block_sparse_moe.experts.{e}"
+                yield "moe_up", get(f"{ep}.w3.weight")
+                yield "moe_gate", get(f"{ep}.w1.weight")
+                yield "moe_down", get(f"{ep}.w2.weight")
+        else:
+            yield "w1", get(f"{pre}.mlp.gate_proj.weight")
+            yield "w2", get(f"{pre}.mlp.down_proj.weight")
+            yield "w3", get(f"{pre}.mlp.up_proj.weight")
+        yield "rms_att", get(f"{pre}.input_layernorm.weight")
+        yield "rms_ffn", get(f"{pre}.post_attention_layernorm.weight")
+    yield "rms_final", get("model.norm.weight")
+    if "lm_head.weight" in ckpt:
+        yield "wcls", get("lm_head.weight")
+    else:  # tied embeddings
+        yield "wcls", get("model.embed_tokens.weight")
+
+
+def convert(model_dir: str, ftype: FloatType, out_path: str,
+            max_seq_len: int = 0) -> ModelSpec:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        config = json.load(f)
+    spec = spec_from_config(config, max_seq_len)
+    ckpt = HfCheckpoint(model_dir)
+    norm_names = {"embedding", "rms_att", "rms_ffn", "rms_moe", "rms_ffn2", "rms_final"}
+    with open(out_path, "wb") as f:
+        write_header(f, spec, ftype)
+        for name, tensor in tensor_plan(spec, ckpt):
+            ft = FloatType.F32 if name in norm_names else ftype
+            write_tensor(f, tensor, ft)
+            print(f"🔶 wrote {name} {tensor.shape} as "
+                  f"{'f32' if name in norm_names else ftype.name.lower()}")
+    print(f"✅ {out_path}")
+    return spec
+
+
+def main(argv=None):
+    argv = argv or sys.argv[1:]
+    if len(argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    model_dir, ft = argv[0], FT[argv[1]]
+    out = argv[2] if len(argv) > 2 else f"dllama_{os.path.basename(model_dir)}_{argv[1]}.m"
+    convert(model_dir, ft, out)
+
+
+if __name__ == "__main__":
+    main()

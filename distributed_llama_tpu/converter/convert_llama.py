@@ -1,0 +1,122 @@
+"""Meta llama checkpoint (consolidated.*.pth shards) -> `.m` converter.
+
+Counterpart of reference converter/convert-llama.py: concatenates tensor shards across
+the consolidated files along the correct parallel axis (column-parallel weights cat on
+axis 0; row-parallel wo/w2 and the embedding cat on axis 1 — convert-llama.py:74-91),
+norms and embedding forced F32, streamed one tensor at a time.
+
+Usage: python -m distributed_llama_tpu.converter.convert_llama <modelDir> <q40|q80|f16|f32> [out.m]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from ..formats.mfile import write_header, write_tensor
+from ..models.spec import ArchType, ModelSpec
+from ..quants import FloatType
+from .convert_hf import FT
+
+# row-parallel (input-dim sharded -> cat axis 1); everything else is axis 0.
+# suffix-matched: per-layer keys arrive as "layers.N.attention.wo.weight"
+_AXIS1_SUFFIXES = (".attention.wo.weight", ".feed_forward.w2.weight",
+                   "tok_embeddings.weight")
+
+
+def _load_shards(model_dir: str):
+    import torch
+
+    paths = sorted(p for p in os.listdir(model_dir) if p.startswith("consolidated."))
+    if not paths:
+        raise FileNotFoundError(f"no consolidated.*.pth in {model_dir}")
+    shards = []
+    for p in paths:
+        print(f"💿 loading {p}...")
+        shards.append(torch.load(os.path.join(model_dir, p), map_location="cpu",
+                                 weights_only=True, mmap=True))
+    return shards
+
+
+def _get(shards, key: str) -> np.ndarray:
+    parts = [s[key] for s in shards]
+    if len(parts) == 1 or parts[0].ndim == 1:
+        t = parts[0]
+    else:
+        import torch
+
+        axis = 1 if key.endswith(_AXIS1_SUFFIXES) else 0
+        t = torch.cat(parts, dim=axis)
+    return t.float().numpy()
+
+
+def spec_from_params(params: dict, vocab_size: int, max_seq_len: int) -> ModelSpec:
+    n_heads = params["n_heads"]
+    dim = params["dim"]
+    # meta params.json stores the ffn multiplier recipe; hidden_dim is derivable but the
+    # tensors carry it directly, so callers pass it in via probe (see convert()).
+    return ModelSpec(
+        arch_type=ArchType.LLAMA,
+        dim=dim,
+        hidden_dim=params["__hidden_dim__"],
+        n_layers=params["n_layers"],
+        n_heads=n_heads,
+        n_kv_heads=params.get("n_kv_heads", n_heads),
+        vocab_size=vocab_size,
+        seq_len=max_seq_len,
+        rope_theta=float(params.get("rope_theta", 10000.0)),
+    )
+
+
+def convert(model_dir: str, ftype: FloatType, out_path: str,
+            max_seq_len: int = 2048) -> ModelSpec:
+    with open(os.path.join(model_dir, "params.json")) as f:
+        params = json.load(f)
+    shards = _load_shards(model_dir)
+    emb = _get(shards, "tok_embeddings.weight")
+    vocab_size, _ = emb.shape
+    params["__hidden_dim__"] = sum(s["layers.0.feed_forward.w1.weight"].shape[0]
+                                   for s in shards)
+    spec = spec_from_params(params, vocab_size, max_seq_len)
+
+    def plan():
+        yield "embedding", emb
+        for l in range(spec.n_layers):
+            pre = f"layers.{l}"
+            yield "wq", _get(shards, f"{pre}.attention.wq.weight")
+            yield "wk", _get(shards, f"{pre}.attention.wk.weight")
+            yield "wv", _get(shards, f"{pre}.attention.wv.weight")
+            yield "wo", _get(shards, f"{pre}.attention.wo.weight")
+            yield "w1", _get(shards, f"{pre}.feed_forward.w1.weight")
+            yield "w2", _get(shards, f"{pre}.feed_forward.w2.weight")
+            yield "w3", _get(shards, f"{pre}.feed_forward.w3.weight")
+            yield "rms_att", _get(shards, f"{pre}.attention_norm.weight")
+            yield "rms_ffn", _get(shards, f"{pre}.ffn_norm.weight")
+        yield "rms_final", _get(shards, "norm.weight")
+        yield "wcls", _get(shards, "output.weight")
+
+    norm_names = {"embedding", "rms_att", "rms_ffn", "rms_final"}
+    with open(out_path, "wb") as f:
+        write_header(f, spec, ftype)
+        for name, tensor in plan():
+            ft = FloatType.F32 if name in norm_names else ftype
+            write_tensor(f, tensor, ft)
+            print(f"🔶 wrote {name} {tensor.shape}")
+    print(f"✅ {out_path}")
+    return spec
+
+
+def main(argv=None):
+    argv = argv or sys.argv[1:]
+    if len(argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    out = argv[2] if len(argv) > 2 else "dllama_model.m"
+    convert(argv[0], FT[argv[1]], out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,181 @@
+"""Tokenizer converters -> `.t` files.
+
+Three sources, mirroring the reference's converter trio:
+- llama2: sentencepiece `tokenizer.model` (convert-tokenizer-llama2.py). The
+  sentencepiece package is not available in this image, so the ModelProto is parsed with
+  a minimal protobuf wire-format reader (field 1 = repeated SentencePiece{1: piece,
+  2: score, 3: type}) — same pieces/scores, no dependency.
+- llama3: tiktoken-format `tokenizer.model` (base64 token + rank per line) with the 256
+  reserved special tokens and the llama3 chat template (convert-tokenizer-llama3.py:13-76).
+- hf: `tokenizer.json` BPE vocab + added_tokens (convert-tokenizer-hf.py:20-64), scores
+  descending by rank.
+
+Usage:
+    python -m distributed_llama_tpu.converter.convert_tokenizer llama2 <dir> [out.t]
+    python -m distributed_llama_tpu.converter.convert_tokenizer llama3 <dir> [out.t]
+    python -m distributed_llama_tpu.converter.convert_tokenizer hf <dir> [out.t]
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import struct
+import sys
+
+from ..formats.tfile import TokenizerData, write_tokenizer
+
+LLAMA2_CHAT_TEMPLATE = (
+    "{% if messages[0]['role'] == 'system' %}...{% endif %}{% for message in messages %}"
+    "{% if message['role'] == 'user' %}{{ bos_token + '[INST] ' + message['content'] + "
+    "' [/INST]' }}{% elif message['role'] == 'assistant' %}{{ message['content'] + "
+    "eos_token }}{% endif %}{% endfor %}")
+
+LLAMA3_CHAT_TEMPLATE = (
+    "{% set loop_messages = messages %}{% for message in loop_messages %}"
+    "{% set content = '<|start_header_id|>' + message['role'] + '<|end_header_id|>\n\n'"
+    "+ message['content'] | trim + '<|eot_id|>' %}{% if loop.index0 == 0 %}"
+    "{% set content = bos_token + content %}{% endif %}{{ content }}{% endfor %}"
+    "{% if add_generation_prompt %}"
+    "{{ '<|start_header_id|>assistant<|end_header_id|>\n\n' }}{% endif %}")
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf wire parser for sentencepiece ModelProto
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _iter_fields(buf: bytes):
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, i = _read_varint(buf, i)
+        elif wire == 1:  # 64-bit
+            val, i = buf[i:i + 8], i + 8
+        elif wire == 2:  # length-delimited
+            ln, i = _read_varint(buf, i)
+            val, i = buf[i:i + ln], i + ln
+        elif wire == 5:  # 32-bit
+            val, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def parse_sentencepiece_model(path: str) -> tuple[list[bytes], list[float]]:
+    """Extract (pieces, scores) from a sentencepiece ModelProto file."""
+    with open(path, "rb") as f:
+        data = f.read()
+    pieces: list[bytes] = []
+    scores: list[float] = []
+    for field, wire, val in _iter_fields(data):
+        if field == 1 and wire == 2:  # repeated SentencePiece
+            piece, score = b"", 0.0
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == 2:
+                    piece = v2
+                elif f2 == 2 and w2 == 5:
+                    score = struct.unpack("<f", v2)[0]
+            pieces.append(piece)
+            scores.append(score)
+    return pieces, scores
+
+
+def convert_llama2(dir_path: str, out: str) -> None:
+    pieces, scores = parse_sentencepiece_model(os.path.join(dir_path, "tokenizer.model"))
+    # sentencepiece marks whitespace with U+2581 (convert-tokenizer-llama2.py:31)
+    vocab = [p.decode("utf-8", "replace").replace("▁", " ").encode() for p in pieces]
+    td = TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=2,
+                       max_token_length=max(len(v) for v in vocab),
+                       chat_template=LLAMA2_CHAT_TEMPLATE)
+    write_tokenizer(out, td)
+    print(f"✅ {out} ({len(vocab)} tokens)")
+
+
+def convert_llama3(dir_path: str, out: str) -> None:
+    """tiktoken-format model: 'base64token rank' lines + 256 reserved specials."""
+    path = os.path.join(dir_path, "tokenizer.model")
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    with open(path, "rb") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            tok_b64, rank = line.split()
+            vocab.append(base64.b64decode(tok_b64))
+            scores.append(-float(int(rank)))
+    n_base = len(vocab)
+    specials = ["<|begin_of_text|>", "<|end_of_text|>",
+                "<|reserved_special_token_0|>", "<|reserved_special_token_1|>",
+                "<|finetune_right_pad_id|>", "<|step_id|>", "<|start_header_id|>",
+                "<|end_header_id|>", "<|eom_id|>", "<|eot_id|>", "<|python_tag|>"]
+    specials += [f"<|reserved_special_token_{i}|>" for i in range(2, 247)]
+    for s in specials:
+        vocab.append(s.encode())
+        scores.append(-float(len(vocab)))
+    bos = n_base + specials.index("<|begin_of_text|>")
+    eos = n_base + specials.index("<|end_of_text|>")
+    eot = n_base + specials.index("<|eot_id|>")
+    td = TokenizerData(vocab=vocab, scores=scores, bos_id=bos, eos_id=eos,
+                       chat_eos_id=eot, max_token_length=max(len(v) for v in vocab),
+                       chat_template=LLAMA3_CHAT_TEMPLATE)
+    write_tokenizer(out, td)
+    print(f"✅ {out} ({len(vocab)} tokens)")
+
+
+def convert_hf_tokenizer(dir_path: str, out: str) -> None:
+    with open(os.path.join(dir_path, "tokenizer_config.json"), encoding="utf-8") as f:
+        cfg = json.load(f)
+    with open(os.path.join(dir_path, "tokenizer.json"), encoding="utf-8") as f:
+        tj = json.load(f)
+    assert tj["model"]["type"] == "BPE", tj["model"]["type"]
+    vocab: list[bytes] = []
+    scores: list[float] = []
+    for token, idx in tj["model"]["vocab"].items():
+        assert idx == len(vocab), "non-contiguous vocab"
+        vocab.append(token.encode())
+        scores.append(-float(idx))
+    bos_id = eos_id = -1
+    for at in tj.get("added_tokens", []):
+        if at["id"] == len(vocab):
+            vocab.append(at["content"].encode())
+            scores.append(-float(at["id"]))
+        if at["content"] == cfg.get("bos_token"):
+            bos_id = at["id"]
+        if at["content"] == cfg.get("eos_token"):
+            eos_id = at["id"]
+    td = TokenizerData(vocab=vocab, scores=scores, bos_id=bos_id, eos_id=eos_id,
+                       chat_eos_id=eos_id, max_token_length=max(len(v) for v in vocab),
+                       chat_template=cfg.get("chat_template"))
+    write_tokenizer(out, td)
+    print(f"✅ {out} ({len(vocab)} tokens)")
+
+
+def main(argv=None):
+    argv = argv or sys.argv[1:]
+    if len(argv) < 2:
+        print(__doc__)
+        sys.exit(1)
+    kind, dir_path = argv[0], argv[1]
+    out = argv[2] if len(argv) > 2 else f"dllama_tokenizer_{kind}.t"
+    {"llama2": convert_llama2, "llama3": convert_llama3,
+     "hf": convert_hf_tokenizer}[kind](dir_path, out)
+
+
+if __name__ == "__main__":
+    main()

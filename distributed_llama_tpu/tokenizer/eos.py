@@ -63,3 +63,32 @@ class EosDetector:
 
     def clear(self) -> None:
         self.buffer.clear()
+
+
+class TokenStreamer:
+    """Drives an EosDetector over a token stream, emitting printable deltas.
+
+    Shared state machine for CLI chat and the API server: holds back bytes that might be
+    the start of a stop sequence, flushes them when they turn out not to be, and reports
+    when generation should stop."""
+
+    def __init__(self, detector: EosDetector, decode_piece, emit):
+        self.detector = detector
+        self.decode_piece = decode_piece
+        self.emit = emit
+        self.stopped = False
+
+    def on_token(self, token_id: int) -> None:
+        res = self.detector.append(token_id, self.decode_piece(token_id))
+        if res == EosResult.MAYBE_EOS:
+            return  # hold back until resolved
+        delta = self.detector.get_delta()
+        if delta:
+            self.emit(delta)
+        if res == EosResult.EOS:
+            self.stopped = True
+        else:
+            self.detector.clear()
+
+    def stop_check(self, _token_id: int) -> bool:
+        return self.stopped

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Model-zoo launcher: download pre-converted `.m`/`.t` models and run the TPU CLI.
+
+Counterpart of the reference launch.py (model zoo at launch.py:14-40) — same public
+pre-converted checkpoints (the file formats are byte-compatible), multi-part downloads
+for the 405B split, and a generated run script that invokes the TPU CLI instead of the
+reference's dllama binary.
+
+Usage: python launch.py <model-name> [--tp N] [--run]
+       python launch.py --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.request
+
+
+def _parts(length: int) -> list[str]:
+    return [chr(97 + i // 26) + chr(97 + i % 26) for i in range(length)]
+
+
+_HF = "https://huggingface.co/b4rtaz"
+
+# name -> (model urls, tokenizer url, weights ftype, buffer ftype, mode)
+MODELS: dict[str, tuple[list[str], str, str, str, str]] = {
+    "tinyllama_1_1b_3t_q40": (
+        [f"{_HF}/TinyLlama-1.1B-3T-Distributed-Llama/resolve/main/dllama_model_tinylama_1.1b_3t_q40.m?download=true"],
+        f"{_HF}/TinyLlama-1.1B-3T-Distributed-Llama/resolve/main/dllama_tokenizer_tinylama_1.1b_3t.t?download=true",
+        "q40", "q80", "base"),
+    "llama3_8b_q40": (
+        [f"{_HF}/Llama-3-8B-Q40-Distributed-Llama/resolve/main/dllama_model_meta-llama-3-8b_q40.m?download=true"],
+        f"{_HF}/Llama-3-8B-Q40-Distributed-Llama/resolve/main/dllama_tokenizer_llama3.t?download=true",
+        "q40", "q80", "base"),
+    "llama3_8b_instruct_q40": (
+        [f"{_HF}/Llama-3-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_model_lama3_instruct_q40.m?download=true"],
+        f"{_HF}/Llama-3-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_tokenizer_llama3.t?download=true",
+        "q40", "q80", "chat"),
+    "llama3_1_8b_instruct_q40": (
+        [f"{_HF}/Llama-3_1-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_model_llama3.1_instruct_q40.m?download=true"],
+        f"{_HF}/Llama-3_1-8B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_tokenizer_llama_3_1.t?download=true",
+        "q40", "q80", "chat"),
+    "llama3_1_405b_instruct_q40": (
+        [f"{_HF}/Llama-3_1-405B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_model_llama31_405b_q40_{s}?download=true"
+         for s in _parts(56)],
+        f"{_HF}/Llama-3_1-405B-Q40-Instruct-Distributed-Llama/resolve/main/dllama_tokenizer_llama_3_1.t?download=true",
+        "q40", "q80", "chat"),
+}
+
+
+def download(urls: list[str], path: str) -> None:
+    if os.path.isfile(path):
+        print(f"✅ {path} already exists")
+        return
+    tmp = path + ".part"
+    with open(tmp, "wb") as out:
+        for url in urls:
+            print(f"📄 {url}")
+            with urllib.request.urlopen(url) as resp:
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    out.write(chunk)
+                    sys.stdout.write(f"\rDownloaded {out.tell() >> 20} MB")
+            sys.stdout.write("\n")
+    os.replace(tmp, path)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", nargs="?")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--tp", type=int, default=None)
+    ap.add_argument("--run", action="store_true", help="run after download")
+    ap.add_argument("--dir", default="models")
+    args = ap.parse_args()
+
+    if args.list or not args.model:
+        print("Available models:")
+        for name in MODELS:
+            print(f"  {name}")
+        return
+    if args.model not in MODELS:
+        sys.exit(f"unknown model {args.model!r}; use --list")
+
+    urls, tok_url, wft, bft, mode = MODELS[args.model]
+    os.makedirs(os.path.join(args.dir, args.model), exist_ok=True)
+    mpath = os.path.join(args.dir, args.model, f"dllama_model_{args.model}.m")
+    tpath = os.path.join(args.dir, args.model, f"dllama_tokenizer_{args.model}.t")
+    download(urls, mpath)
+    download([tok_url], tpath)
+
+    cli_mode = "chat" if mode == "chat" else "inference"
+    cmd = (f"python -m distributed_llama_tpu.apps.dllama {cli_mode} "
+           f"--model {mpath} --tokenizer {tpath} "
+           f"--weights-float-type {wft} --buffer-float-type {bft} --max-seq-len 4096"
+           + (f" --tp {args.tp}" if args.tp else ""))
+    script = f"run_{args.model}.sh"
+    with open(script, "w") as f:
+        f.write("#!/bin/sh\n" + cmd + "\n")
+    os.chmod(script, 0o755)
+    print(f"📜 wrote {script}")
+    if args.run:
+        os.execvp("sh", ["sh", script])
+
+
+if __name__ == "__main__":
+    main()
