@@ -45,7 +45,8 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                    choices=["f32", "f16", "q40", "q80"],
                    help="q80 enables int8-compressed collectives (wire compression)")
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel devices")
-    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--dtype", default="auto", choices=["auto", "float32", "bfloat16"],
+                   help="auto = bfloat16 on TPU, float32 on CPU")
     p.add_argument("--no-pallas", action="store_true")
     p.add_argument("--nthreads", type=int, default=None, help="ignored (XLA owns the chip)")
     p.add_argument("--kv-cache-storage", default=None, help="ignored (KV lives in HBM)")
@@ -65,7 +66,8 @@ def make_engine(args) -> Engine:
         args.model, args.tokenizer, max_seq_len=args.max_seq_len,
         weights_ftype=_FT[args.weights_float_type] if args.weights_float_type else None,
         tp=args.tp,
-        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        dtype=(None if args.dtype == "auto"
+               else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
         use_pallas=False if args.no_pallas else None,
         compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1,
     )

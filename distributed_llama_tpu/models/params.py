@@ -84,24 +84,24 @@ def init_random_params(spec: ModelSpec, weights_ftype: FloatType = FloatType.F32
     }
 
 
-# col-parallel (input-dim-sharded) tensors need shard-local TPU repacking
-_COL_PARALLEL = {"wo", "w2", "moe_down"}
+_I8_CONVERTIBLE = (FloatType.Q40, FloatType.Q80)
 
 
 def prepare_for_pallas(params: Params, tp: int = 1) -> Params:
-    """Repack every 2-D-logical Q40 matmul weight into the Pallas kernel's block-strided
-    layout (quants.q40_repack_tpu). `tp` must match the mesh's tp size so col-parallel
-    slices remain self-contained permuted segments."""
+    """Expand every quantized matmul weight into int8 planes (QTensor.to_i8_layout) for
+    the Pallas MXU matvec kernel. Both tensor axes slice cleanly (quant blocks stay
+    32-aligned), so the layout is TP-agnostic; `tp` is accepted for API stability."""
+    del tp
     out: Params = {"embedding": params["embedding"], "blocks": {},
                    "rms_final": params["rms_final"]}
     for name, t in params["blocks"].items():
-        if isinstance(t, QTensor) and t.ftype == FloatType.Q40:
-            out["blocks"][name] = t.to_tpu_layout(tp if name in _COL_PARALLEL else 1)
+        if isinstance(t, QTensor) and t.ftype in _I8_CONVERTIBLE:
+            out["blocks"][name] = t.to_i8_layout()
         else:
             out["blocks"][name] = t
     wcls = params["wcls"]
-    if isinstance(wcls, QTensor) and wcls.ftype == FloatType.Q40:
-        wcls = wcls.to_tpu_layout(1)
+    if isinstance(wcls, QTensor) and wcls.ftype in _I8_CONVERTIBLE:
+        wcls = wcls.to_i8_layout()
     out["wcls"] = wcls
     return out
 

@@ -4,12 +4,12 @@ The reference dispatches on (weightType x inputType) pairs of hand-written SIMD 
 (src/funcs.cpp:424-465, hot path matmulQ40vQ80 at funcs.cpp:287-396). Here there is ONE
 logical op: y[..., out] = x[..., in] · W[out, in], where W may be dense or block-quantized.
 
-Two execution paths:
-- `qmatmul` (this module): dequantize-to-dtype + `jnp.einsum`; XLA fuses the nibble unpack
-  and scale broadcast into the matmul's operand pipeline. Correct everywhere (CPU mesh
-  tests, TPU), and the baseline the Pallas kernel must beat.
-- `pallas_q40.q40_matmul`: fused HBM->VMEM dequant matmul kernel (see ops/pallas_q40.py),
-  enabled via `use_pallas=True` when running on real TPU.
+Execution paths:
+- decode (one row of activations) with i8-layout weights: `pallas_q8.q8_matvec`, the
+  fused int8-plane MXU kernel (HBM-bandwidth-bound, zero per-weight VPU work).
+- everything else: dequantize-to-dtype + `dot_general`; XLA fuses the scale broadcast
+  into the matmul's operand pipeline. Prefill lands here on purpose — with many
+  activation rows the per-weight dequant amortizes and the MXU runs dense bf16.
 
 Weights keep the reference's (out, in) row-major orientation with quant blocks along `in`
 (src/commands.cpp:22-39), so TP row/col splits slice whole blocks.
@@ -17,19 +17,22 @@ Weights keep the reference's (out, in) row-major orientation with quant blocks a
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
-from ..quants import FloatType, QTensor
+from ..quants import QTensor
 
 
 def qmatmul(x: jax.Array, w: QTensor, *, use_pallas: bool = False,
             out_dtype=None) -> jax.Array:
     """y = x @ W^T for W of logical shape (out, in); x: (..., in) -> (..., out)."""
-    if use_pallas and w.ftype == FloatType.Q40 and w.layout == "tpu" and w.data.ndim == 2:
-        from .pallas_q40 import q40_matmul
+    if use_pallas and math.prod(x.shape[:-1]) == 1:
+        from .pallas_q8 import q8_decode_supported, q8_matvec
 
-        return q40_matmul(x, w, out_dtype=out_dtype or x.dtype)
+        if q8_decode_supported(w):
+            return q8_matvec(x, w, out_dtype=out_dtype or x.dtype)
     wd = w.dequantize(dtype=x.dtype)
     y = jax.lax.dot_general(
         x, wd,

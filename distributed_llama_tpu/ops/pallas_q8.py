@@ -1,0 +1,157 @@
+"""Fused int8-plane quantized matvec — the TPU descendant of matmulQ40vQ80.
+
+The reference's hot loop (src/funcs.cpp:287-396) dot-products 4-bit weight blocks against
+Q80-quantized activations with NEON `vdotq_s32`. A literal nibble-unpack kernel on TPU is
+VPU-bound (~4 vector ops per weight swamp the MXU). Instead the load path expands Q40
+nibbles once into **int8 planes** (`QTensor.to_i8_layout`): data int8 (out, K) holding
+(nibble - 8), scales f32 (out, K/32). That costs 1 B/weight of HBM instead of 0.56, but
+decode becomes pure MXU int8 work with zero per-weight VPU ops:
+
+    y[n] = sum_b s[n,b] * sx[b] * P[n,b],   P = W8 @ Xexp   (int8 x int8 -> int32 MXU)
+
+where Xexp (K, nb) is the activation vector quantized to int8 per 32-block (exactly the
+reference's Q80 buffer semantics, src/tasks.cpp:96-135) and scattered block-diagonally:
+Xexp[j, b] = xq[j] if j//32 == b else 0. A batch-1 matvec wastes 127/128 of every MXU pass
+anyway; Xexp fills those wasted columns with the per-block partial sums, so the int8
+matmul costs the same MXU passes as a plain matvec while making the per-block scale
+structure a 32x-smaller (out, nb) elementwise epilogue instead of a per-weight multiply.
+
+Decode (M=1) uses this kernel; prefill (M>1) amortizes a per-weight dequant over the
+batch and goes through the XLA path in ops/matmul.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quants import QK, FloatType, QTensor
+
+
+def _matvec_kernel(xexp_ref, sx_ref, w_ref, s_ref, o_ref):
+    # P[n, b] = sum_{j in block b} W8[n, j] * xq[j] — int8 x int8 -> int32 on the MXU
+    p = jax.lax.dot_general(w_ref[:], xexp_ref[:], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    y = (s_ref[:] * sx_ref[:]) * p.astype(jnp.float32)  # (bn, nb) epilogue
+    o_ref[:] = jnp.sum(y, axis=1, keepdims=True)
+
+
+def _matvec_kernel_f32(xexp_ref, sx_ref, w_ref, s_ref, o_ref):
+    # precise path: activations stay f32 (no Q80 step); weights convert once to f32.
+    # Used by parity tests; decode perf path is the int8 kernel above.
+    p = jax.lax.dot_general(w_ref[:].astype(jnp.float32), xexp_ref[:],
+                            (((1,), (0,)), ((), ())),
+                            precision=jax.lax.Precision.HIGHEST,
+                            preferred_element_type=jnp.float32)
+    y = (s_ref[:] * sx_ref[:]) * p
+    o_ref[:] = jnp.sum(y, axis=1, keepdims=True)
+
+
+def _pick_bn(n: int, k: int, budget_bytes: int = 3 << 20) -> int:
+    """Largest 128-multiple row-block whose (bn, K) int8 block fits the VMEM budget
+    (double-buffered by Pallas). bn need not divide n: the grid is cdiv(n, bn) and
+    Mosaic masks the trailing partial block. Tiny n uses the whole axis."""
+    if n <= 128:
+        return n
+    cap = max(budget_bytes // max(k, 1), 128)
+    return max(min(cap, n) // 128 * 128, 128)
+
+
+# Above this VMEM footprint for the resident (K, nb) Xexp operand the kernel would not
+# fit alongside the double-buffered weight blocks; callers (ops.matmul.qmatmul) fall back
+# to the XLA dequant path. K=16384 (405B-class dim) stays comfortably under it.
+_XEXP_VMEM_LIMIT = 9 << 20
+
+
+def q8_decode_supported(w: QTensor, precise: bool = False) -> bool:
+    """Whether the fused matvec kernel can run this weight shape on TPU."""
+    if w.layout != "i8" or w.data.ndim != 2:
+        return False
+    n, k = w.data.shape
+    nb = k // QK
+    esize = 4 if precise else 1
+    return k * nb * esize <= _XEXP_VMEM_LIMIT
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "precise"))
+def _q8_matvec(xexp, sx, w8, scales, *, interpret: bool = False, precise: bool = False):
+    """y (n, 1) f32 from block-diagonal Xexp (K, nb), sx (1, nb), int8 planes (n, K),
+    scales (n, nb)."""
+    k, nb = xexp.shape
+    n, k2 = w8.shape
+    assert k2 == k and scales.shape == (n, nb) and nb * QK == k, (
+        xexp.shape, w8.shape, scales.shape)
+    bn = _pick_bn(n, k)
+    kernel = _matvec_kernel_f32 if precise else _matvec_kernel
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(n, bn),),
+        in_specs=[
+            pl.BlockSpec((k, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        interpret=interpret,
+    )(xexp, sx, w8, scales)
+
+
+def _expand_q80(x_row: jax.Array, nb: int):
+    """Quantize one activation row (K,) to per-block int8 and scatter block-diagonally.
+
+    Returns (Xexp (K, nb) int8, sx (1, nb) f32). Runs in XLA outside the kernel, where
+    the quantize fuses with the producer (the reference quantizes activations to Q80
+    before every sliced matmul the same way, src/tasks.cpp:96-135).
+    """
+    k = x_row.shape[0]
+    g = x_row.reshape(nb, QK).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(g), axis=-1)
+    sx = absmax / 127.0
+    inv = jnp.where(absmax > 0, 127.0 / absmax, 0.0)
+    xq = jnp.round(g * inv[:, None]).astype(jnp.int8).reshape(k)
+    block_of = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0) // QK
+    b_idx = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
+    xexp = jnp.where(block_of == b_idx, xq[:, None], jnp.int8(0))
+    return xexp, sx[None, :]
+
+
+def _expand_f32(x_row: jax.Array, nb: int):
+    """Precise-path variant: no activation quantization, unit block scales."""
+    k = x_row.shape[0]
+    block_of = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0) // QK
+    b_idx = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
+    xexp = jnp.where(block_of == b_idx, x_row.astype(jnp.float32)[:, None], 0.0)
+    return xexp, jnp.ones((1, nb), jnp.float32)
+
+
+def q8_matvec(x: jax.Array, w: QTensor, *, out_dtype=None,
+              interpret: bool | None = None, precise: bool | None = None) -> jax.Array:
+    """Decode-path matmul: x (..., K) with leading dims multiplying to 1, int8-layout
+    QTensor (N, K) -> (..., N)."""
+    if w.layout != "i8":
+        raise ValueError(
+            "q8_matvec needs i8-layout weights; run models.params.prepare_for_pallas "
+            "(or QTensor.to_i8_layout) on the params first")
+    assert w.data.ndim == 2, w.data.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # precise (f32 activations, no Q80 step) is a parity-test tool, explicit opt-in only:
+    # the production decode path quantizes activations to int8 exactly like the
+    # reference's Q80 buffers regardless of the ambient compute dtype.
+    precise = bool(precise)
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    nb = k // QK
+    x_row = x.reshape(k)
+    if precise:
+        xexp, sx = _expand_f32(x_row, nb)
+    else:
+        xexp, sx = _expand_q80(x_row, nb)
+    y = _q8_matvec(xexp, sx, w.data, w.scales, interpret=interpret, precise=precise)
+    return y.reshape(*lead, y.shape[0]).astype(out_dtype or x.dtype)

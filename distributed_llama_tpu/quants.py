@@ -170,17 +170,15 @@ def jnp_dequantize_q40(packed: jax.Array, scales: jax.Array, dtype=jnp.bfloat16)
     return out.reshape(*packed.shape[:-2], packed.shape[-2] * QK)
 
 
-def jnp_dequantize_q40_tpu(packed2: jax.Array, scales: jax.Array,
-                           dtype=jnp.bfloat16) -> jax.Array:
-    """Dequantize the TPU-permuted layout (single segment) back to natural order."""
+def jnp_dequantize_i8(values: jax.Array, scales: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    """Dequantize the int8-plane layout: (..., K) i8 + (..., K//32) f32 -> (..., K).
+
+    Same math as Q80 planar dequant after regrouping the flat K axis into blocks.
+    """
+    k = values.shape[-1]
     nb = scales.shape[-1]
-    lead = packed2.shape[:-1]
-    p = packed2.reshape(*lead, 16, nb)
-    lo = (p & 0x0F).astype(jnp.int8) - 8
-    hi = (p >> 4).astype(jnp.int8) - 8
-    w = jnp.concatenate([lo, hi], axis=-2)  # (..., 32, nb) intra-major
-    w = jnp.swapaxes(w, -1, -2).astype(dtype) * scales[..., None].astype(dtype)
-    return w.reshape(*lead, nb * QK)
+    assert nb * QK == k, (values.shape, scales.shape)
+    return jnp_dequantize_q80(values.reshape(*values.shape[:-1], nb, QK), scales, dtype)
 
 
 def jnp_dequantize_q80(values: jax.Array, scales: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
@@ -204,72 +202,6 @@ def jnp_quantize_q80(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 # ---------------------------------------------------------------------------
-# TPU-permuted Q40 layout for the Pallas fused dequant-matmul kernel
-# ---------------------------------------------------------------------------
-#
-# Mosaic cannot reshape (BN, nb, 32) -> (BN, K) in registers, so the kernel needs a layout
-# where scales broadcast along lanes WITHOUT a reshape. pltpu.repeat has tile semantics
-# ([s0..s_nb] * 32), so we permute weight columns block-strided: element (block b,
-# intra i) lives at column i*nb + b. Then lane j's scale is s[j % nb] == tile-repeat, and
-# the nibble halves unpack into two contiguous lane ranges (i<16 -> low nibbles,
-# i>=16 -> high). Activations get the same column permutation (cheap XLA transpose).
-#
-# `n_shards` makes the permutation local to each of n contiguous K-segments so a
-# col-parallel (input-dim) TP shard of the packed array is itself a valid permuted layout.
-
-
-def q40_repack_tpu(packed: np.ndarray, scales: np.ndarray, n_shards: int = 1) -> np.ndarray:
-    """Planar Q40 packed (..., nb, 16) -> TPU-permuted packed2 (..., nb*16).
-
-    packed2[..., j] holds (for each K-shard segment independently, nb_l = nb/n_shards):
-    low nibble = element at permuted pos j = i*nb_l+b for i<16, high nibble = same j with
-    i+16. scales stay (..., nb) unchanged.
-    """
-    nb = packed.shape[-2]
-    assert nb % n_shards == 0, (nb, n_shards)
-    nb_l = nb // n_shards
-    lead = packed.shape[:-2]
-    q = packed.reshape(*lead, n_shards, nb_l, 16)
-    lo = q & 0x0F  # intra i = 0..15, element (b, i)
-    hi = q >> 4  # intra i = 16..31
-    # permuted: pos j = i*nb_l + b  ->  transpose (nb_l, 16) -> (16, nb_l)
-    lo_p = np.swapaxes(lo, -1, -2).reshape(*lead, n_shards, nb_l * 16)
-    hi_p = np.swapaxes(hi, -1, -2).reshape(*lead, n_shards, nb_l * 16)
-    out = (lo_p | (hi_p << 4)).astype(np.uint8)
-    return out.reshape(*lead, nb * 16)
-
-
-def permute_activations_tpu(x, nb: int, n_shards: int = 1):
-    """Match q40_repack_tpu's column permutation on the activation side (jnp or numpy).
-
-    x: (..., K) with K = nb*32 -> same shape, columns permuted per K-shard segment.
-    """
-    xp = jnp if isinstance(x, jax.Array) else np
-    k = x.shape[-1]
-    assert k == nb * QK, (x.shape, nb)
-    nb_l = nb // n_shards
-    lead = x.shape[:-1]
-    x4 = x.reshape(*lead, n_shards, nb_l, QK)
-    x4 = xp.swapaxes(x4, -1, -2)  # (..., n_shards, 32, nb_l)
-    return x4.reshape(*lead, k)
-
-
-def dequantize_q40_tpu(packed2: np.ndarray, scales: np.ndarray,
-                       n_shards: int = 1) -> np.ndarray:
-    """TPU-permuted packed2 (..., nb*16) + scales (..., nb) -> natural-order floats."""
-    nb = scales.shape[-1]
-    nb_l = nb // n_shards
-    lead = packed2.shape[:-1]
-    p = packed2.reshape(*lead, n_shards, 16, nb_l)
-    lo = (p & 0x0F).astype(np.int8) - 8  # i = 0..15
-    hi = (p >> 4).astype(np.int8) - 8  # i = 16..31
-    w = np.concatenate([lo, hi], axis=-2)  # (..., n_shards, 32, nb_l) intra-major
-    w = np.swapaxes(w, -1, -2).reshape(*lead, nb, QK).astype(np.float32)
-    w = w * scales[..., None].astype(np.float32)
-    return w.reshape(*lead, nb * QK)
-
-
-# ---------------------------------------------------------------------------
 # QTensor: a quantized-or-not weight tensor as a pytree
 # ---------------------------------------------------------------------------
 
@@ -289,15 +221,15 @@ class QTensor:
     ftype: FloatType
     data: jax.Array | np.ndarray  # dense values, Q40 packed u8, or Q80 int8
     scales: jax.Array | np.ndarray | None = None  # f16 per-block scales for Q40/Q80
-    layout: str = "planar"  # "planar" | "tpu" (block-strided permuted, see q40_repack_tpu)
+    layout: str = "planar"  # "planar" | "i8" (int8 planes for the MXU kernel, to_i8_layout)
 
     @property
     def shape(self) -> tuple[int, ...]:
         """Logical (dequantized) shape."""
         if self.ftype in (FloatType.F32, FloatType.F16):
             return tuple(self.data.shape)
-        if self.ftype == FloatType.Q40 and self.layout == "tpu":
-            return (*self.data.shape[:-1], self.data.shape[-1] * 2)
+        if self.layout == "i8":
+            return tuple(self.data.shape)
         if self.ftype in (FloatType.Q40, FloatType.Q80):
             return (*self.data.shape[:-2], self.data.shape[-2] * QK)
         raise ValueError(self.ftype)
@@ -317,14 +249,28 @@ class QTensor:
             scales = None
         return cls(ftype=ftype, data=data, scales=scales, layout=layout)
 
-    def to_tpu_layout(self, n_shards: int = 1) -> "QTensor":
-        """Repack planar Q40 into the Pallas kernel's block-strided layout (host-side)."""
-        assert self.ftype == FloatType.Q40 and self.layout == "planar", (
-            self.ftype, self.layout)
-        packed2 = q40_repack_tpu(np.asarray(self.data), np.asarray(self.scales), n_shards)
-        # Mosaic has no f16 support: carry scales as f32 (exact upcast, dequant unchanged)
+    def to_i8_layout(self) -> "QTensor":
+        """Expand planar Q40/Q80 into int8 planes for the MXU matvec kernel (pallas_q8).
+
+        data int8 (..., K) holding (nibble - 8) for Q40 / raw int8 for Q80, natural
+        column order; scales f32 (..., K//32). Costs 2x (Q40) the packed HBM bytes but
+        removes every per-weight VPU op from decode; both axes slice cleanly for TP
+        (blocks stay 32-aligned), so no per-shard segmenting is needed.
+        """
+        assert self.layout == "planar", self.layout
+        if self.ftype == FloatType.Q40:
+            packed = np.asarray(self.data)
+            lo = (packed & 0x0F).astype(np.int8) - 8  # elements 0..15 of each block
+            hi = (packed >> 4).astype(np.int8) - 8  # elements 16..31
+            vals = np.concatenate([lo, hi], axis=-1)  # (..., nb, 32)
+        elif self.ftype == FloatType.Q80:
+            vals = np.asarray(self.data, dtype=np.int8)
+        else:
+            raise ValueError(self.ftype)
+        k = vals.shape[-2] * QK
+        data = vals.reshape(*vals.shape[:-2], k)
         scales32 = np.asarray(self.scales, dtype=np.float32)
-        return QTensor(self.ftype, packed2, scales32, layout="tpu")
+        return QTensor(self.ftype, data, scales32, layout="i8")
 
     @classmethod
     def from_float(cls, x: np.ndarray, ftype: FloatType) -> "QTensor":
@@ -345,9 +291,9 @@ class QTensor:
         """Materialize logical values on device (jnp path; Pallas kernels bypass this)."""
         if self.ftype in (FloatType.F32, FloatType.F16):
             return jnp.asarray(self.data).astype(dtype)
-        if self.ftype == FloatType.Q40 and self.layout == "tpu":
-            return jnp_dequantize_q40_tpu(jnp.asarray(self.data), jnp.asarray(self.scales),
-                                          dtype)
+        if self.layout == "i8":
+            return jnp_dequantize_i8(jnp.asarray(self.data), jnp.asarray(self.scales),
+                                     dtype)
         if self.ftype == FloatType.Q40:
             return jnp_dequantize_q40(jnp.asarray(self.data), jnp.asarray(self.scales), dtype)
         if self.ftype == FloatType.Q80:
@@ -357,8 +303,10 @@ class QTensor:
     def to_numpy(self) -> np.ndarray:
         if self.ftype in (FloatType.F32, FloatType.F16):
             return np.asarray(self.data, dtype=np.float32)
-        if self.ftype == FloatType.Q40 and self.layout == "tpu":
-            return dequantize_q40_tpu(np.asarray(self.data), np.asarray(self.scales))
+        if self.layout == "i8":
+            nb = self.scales.shape[-1]
+            g = np.asarray(self.data).reshape(*self.data.shape[:-1], nb, QK)
+            return dequantize_q80(g, np.asarray(self.scales))
         if self.ftype == FloatType.Q40:
             return dequantize_q40(np.asarray(self.data), np.asarray(self.scales))
         if self.ftype == FloatType.Q80:

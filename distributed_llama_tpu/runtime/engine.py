@@ -77,27 +77,32 @@ def collective_kbytes_per_token(spec: ModelSpec, tp: int, compress: bool) -> flo
 
 class Engine:
     def __init__(self, spec: ModelSpec, params: Params, tokenizer: Tokenizer | None = None,
-                 *, tp: int | None = None, dtype=jnp.float32, use_pallas: bool | None = None,
+                 *, tp: int | None = None, dtype=None, use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1):
         self.spec = spec
         self.tokenizer = tokenizer
-        self.dtype = dtype
+        on_tpu = jax.default_backend() == "tpu"
+        # decode is HBM-bound on TPU: bf16 activations/caches halve cache traffic, and
+        # matvec numerics are int8 (Q80) in the kernel either way. f32 on CPU keeps the
+        # golden/parity tests exact.
+        self.dtype = dtype if dtype is not None else (jnp.bfloat16 if on_tpu
+                                                      else jnp.float32)
         self.compress = compress_collectives
         if use_pallas is None:
-            use_pallas = jax.default_backend() == "tpu"
+            use_pallas = on_tpu
         self.mesh = make_mesh(tp=tp)
         self.tp = self.mesh.shape[AXIS_TP]
-        has_q40 = any(
-            getattr(t, "ftype", None) == FloatType.Q40
+        has_quant = any(
+            getattr(t, "ftype", None) in (FloatType.Q40, FloatType.Q80)
             for t in params["blocks"].values())
-        self.use_pallas = use_pallas and has_q40
+        self.use_pallas = use_pallas and has_quant
         if self.use_pallas:
             params = prepare_for_pallas(params, self.tp)
         self.params = shard_params(params, self.mesh, spec)
         self.rope = RopeTables.create(spec)
         self.batch = batch
         self._step = make_sharded_forward(
-            spec, self.mesh, self.params, dtype=dtype, use_pallas=self.use_pallas,
+            spec, self.mesh, self.params, dtype=self.dtype, use_pallas=self.use_pallas,
             compress_collectives=compress_collectives, donate_cache=True)
         self.k_cache, self.v_cache = self._init_cache()
         self.pos = 0
