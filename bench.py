@@ -99,6 +99,11 @@ def main():
     params = synth_params(spec, on_tpu)
     params = shard_params(params, mesh, spec)
     rope = RopeTables.create(spec)
+    # per-token dispatch with donated KV caches: XLA aliases the donated buffers so the
+    # per-layer cache restack is in-place. (The on-device scan loop in
+    # runtime/device_loop.py dispatches once per chunk, but loop-carried caches lose
+    # that aliasing and ping-pong ~2x cache bytes per token — measured strictly slower
+    # here, so the host loop is the benchmark path.)
     step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
                                 donate_cache=True)
     kc, vc = init_kv_cache(spec, dtype=dtype)

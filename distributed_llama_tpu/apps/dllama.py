@@ -48,6 +48,9 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="auto", choices=["auto", "float32", "bfloat16"],
                    help="auto = bfloat16 on TPU, float32 on CPU")
     p.add_argument("--no-pallas", action="store_true")
+    p.add_argument("--device-loop", type=int, default=0, metavar="CHUNK",
+                   help="decode CHUNK tokens per dispatch with the on-device scan loop "
+                        "(runtime/device_loop.py); 0 = per-token host loop")
     p.add_argument("--nthreads", type=int, default=None, help="ignored (XLA owns the chip)")
     p.add_argument("--kv-cache-storage", default=None, help="ignored (KV lives in HBM)")
     return p
@@ -98,7 +101,11 @@ def mode_inference(args) -> None:
         piece = tok.decode_piece(prompt[-1] if not pieces else 0, t)
         pieces.append(piece)
 
-    out, stats = engine.generate(prompt, args.steps, sampler, on_token=on_token)
+    if args.device_loop:
+        out, stats = engine.generate_chunked(prompt, args.steps, sampler,
+                                             on_token=on_token, chunk=args.device_loop)
+    else:
+        out, stats = engine.generate(prompt, args.steps, sampler, on_token=on_token)
     text = b"".join(pieces).decode("utf-8", errors="replace")
     print(text)
     # per-token stats table like dllama.cpp:76-93
@@ -126,8 +133,10 @@ def mode_generate(args) -> None:
         sys.stdout.flush()
         prev = t
 
-    engine.generate(prompt, args.steps, sampler, on_token=on_token,
-                    stop_check=lambda t: t == tok.eos_id)
+    gen = engine.generate_chunked if args.device_loop else engine.generate
+    kw = {"chunk": args.device_loop} if args.device_loop else {}
+    gen(prompt, args.steps, sampler, on_token=on_token,
+        stop_check=lambda t: t == tok.eos_id, **kw)
     print()
 
 

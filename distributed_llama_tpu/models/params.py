@@ -86,21 +86,36 @@ def init_random_params(spec: ModelSpec, weights_ftype: FloatType = FloatType.F32
 
 _I8_CONVERTIBLE = (FloatType.Q40, FloatType.Q80)
 
+# per-layer tensors whose scan-sliced form is the 2-D matvec the q8 kernel consumes.
+# MoE expert stacks (3-D per layer) and the router (use_pallas=False in forward) stay
+# planar: the kernel can't take them, and i8 planes would double their HBM for nothing.
+_DENSE_MATMULS = {"wq", "wk", "wv", "wo", "w1", "w2", "w3"}
+
+
+def _kernel_convertible(t: QTensor, stacked: bool) -> bool:
+    from ..ops.pallas_q8 import q8_shape_supported
+
+    if not (isinstance(t, QTensor) and t.ftype in _I8_CONVERTIBLE):
+        return False
+    shape = t.shape[1:] if stacked else t.shape
+    return len(shape) == 2 and q8_shape_supported(*shape)
+
 
 def prepare_for_pallas(params: Params, tp: int = 1) -> Params:
-    """Expand every quantized matmul weight into int8 planes (QTensor.to_i8_layout) for
-    the Pallas MXU matvec kernel. Both tensor axes slice cleanly (quant blocks stay
-    32-aligned), so the layout is TP-agnostic; `tp` is accepted for API stability."""
+    """Expand the dense matmul weights into int8 planes (QTensor.to_i8_layout) for the
+    Pallas MXU matvec kernel. Both tensor axes slice cleanly (quant blocks stay
+    32-aligned), so the layout is TP-agnostic; `tp` is accepted for API stability.
+    Tensors the kernel can't consume keep the packed planar layout (half the HBM)."""
     del tp
     out: Params = {"embedding": params["embedding"], "blocks": {},
                    "rms_final": params["rms_final"]}
     for name, t in params["blocks"].items():
-        if isinstance(t, QTensor) and t.ftype in _I8_CONVERTIBLE:
+        if name in _DENSE_MATMULS and _kernel_convertible(t, stacked=True):
             out["blocks"][name] = t.to_i8_layout()
         else:
             out["blocks"][name] = t
     wcls = params["wcls"]
-    if isinstance(wcls, QTensor) and wcls.ftype in _I8_CONVERTIBLE:
+    if _kernel_convertible(wcls, stacked=False):
         wcls = wcls.to_i8_layout()
     out["wcls"] = wcls
     return out

@@ -67,14 +67,18 @@ def _pick_bn(n: int, k: int, budget_bytes: int = 3 << 20) -> int:
 _XEXP_VMEM_LIMIT = 9 << 20
 
 
-def q8_decode_supported(w: QTensor, precise: bool = False) -> bool:
-    """Whether the fused matvec kernel can run this weight shape on TPU."""
-    if w.layout != "i8" or w.data.ndim != 2:
-        return False
-    n, k = w.data.shape
+def q8_shape_supported(n: int, k: int, precise: bool = False) -> bool:
+    """Whether the fused matvec kernel can run a (n, k)-logical weight on TPU."""
     nb = k // QK
     esize = 4 if precise else 1
     return k * nb * esize <= _XEXP_VMEM_LIMIT
+
+
+def q8_decode_supported(w: QTensor, precise: bool = False) -> bool:
+    """Whether the fused matvec kernel can run this weight tensor on TPU."""
+    if w.layout != "i8" or w.data.ndim != 2:
+        return False
+    return q8_shape_supported(*w.data.shape, precise=precise)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "precise"))

@@ -106,6 +106,7 @@ class Engine:
             compress_collectives=compress_collectives, donate_cache=True)
         self.k_cache, self.v_cache = self._init_cache()
         self.pos = 0
+        self._decode_loops: dict[int, object] = {}  # chunk size -> compiled device loop
 
     @classmethod
     def load(cls, model_path: str, tokenizer_path: str | None = None, *,
@@ -194,4 +195,85 @@ class Engine:
             t2 = time.perf_counter()
             stats.infer_ms.append((t2 - t1) * 1000.0)
             stats.token_ms.append((t2 - t0) * 1000.0)
+        return out, stats
+
+    # ------------------------------------------------------------------
+    # device-loop generation (one dispatch per chunk of tokens)
+    # ------------------------------------------------------------------
+
+    def _decode_loop(self, chunk: int, mode: str):
+        if (chunk, mode) not in self._decode_loops:
+            from .device_loop import make_decode_loop
+
+            self._decode_loops[chunk, mode] = make_decode_loop(
+                self.spec, self.mesh, self.params, chunk, mode=mode, dtype=self.dtype,
+                use_pallas=self.use_pallas,
+                compress_collectives=self.compress, donate_cache=True)
+        return self._decode_loops[chunk, mode]
+
+    def generate_chunked(self, prompt_tokens: list[int], max_tokens: int, sampler,
+                         on_token=None, stop_check=None, chunk: int = 16,
+                         ) -> tuple[list[int], GenerationStats]:
+        """Generate with the on-device scan loop: forward + sample stay on device and
+        each dispatch returns `chunk` tokens (vs the reference's strictly per-token host
+        loop, dllama.cpp:17-94). Greedy (temperature 0) emits exactly the host loop's
+        tokens; stochastic sampling uses the device PRNG (not xorshift-bit-compatible).
+
+        KV-cache positions beyond an early stop are overwritten by later writes at those
+        positions, so mid-chunk stops need no rollback.
+        """
+        stats = GenerationStats()
+        stats.sent_kbytes_per_token = stats.recv_kbytes_per_token = (
+            collective_kbytes_per_token(self.spec, self.tp, self.compress))
+        if len(prompt_tokens) > 1:
+            self.prefill(prompt_tokens[:-1], stats)
+        stats.prompt_tokens = len(prompt_tokens)
+        key = jax.random.PRNGKey(int(getattr(sampler, "state", 0)))
+        temperature = getattr(sampler, "temperature", 0.0)
+        topp = getattr(sampler, "topp", 0.9)
+        out: list[int] = []
+        token = prompt_tokens[-1]
+        mode = "greedy" if temperature == 0.0 else "sample"
+        done = False
+        while not done and len(out) < max_tokens:
+            want = max_tokens - len(out)
+            seq_left = self.spec.seq_len - self.pos
+            if seq_left <= 0:
+                break
+            if seq_left < chunk:
+                # near the context end a full chunk would overrun the cache; finish
+                # with the per-token host loop instead of compiling a tail-sized scan
+                tail, tail_stats = self.generate(
+                    [token], min(want, seq_left), sampler, on_token=on_token,
+                    stop_check=stop_check)
+                out.extend(tail)
+                stats.generated_tokens += len(tail)
+                stats.token_ms.extend(tail_stats.token_ms)
+                stats.infer_ms.extend(tail_stats.infer_ms)
+                break
+            # always run the compiled full-chunk program; a short tail (want < chunk)
+            # just truncates the emitted tokens — cache entries past pos are dead and
+            # overwritten by later writes at those positions
+            loop = self._decode_loop(chunk, mode)
+            t0 = time.perf_counter()
+            key, sub = jax.random.split(key)
+            tokens, _, self.k_cache, self.v_cache = loop(
+                self.params, self.rope, token, self.k_cache, self.v_cache, self.pos,
+                sub, temperature, topp)
+            tokens = np.asarray(tokens)[:want]
+            dt_ms = (time.perf_counter() - t0) * 1000.0 / len(tokens)
+            for i, t in enumerate(tokens.tolist()):
+                out.append(t)
+                stats.generated_tokens += 1
+                stats.token_ms.append(dt_ms)
+                stats.infer_ms.append(dt_ms)
+                if on_token is not None:
+                    on_token(t)
+                if stop_check is not None and stop_check(t):
+                    done = True
+                    self.pos += i + 1
+                    break
+            else:
+                self.pos += len(tokens)
+                token = int(tokens[-1])
         return out, stats
