@@ -48,13 +48,14 @@ class NaiveCache:
 
 class ApiState:
     def __init__(self, engine: Engine, template_type: TemplateType,
-                 default_sampler: Sampler):
+                 default_sampler: Sampler, device_loop_chunk: int = 0):
         self.engine = engine
         self.lock = threading.Lock()
         self.cache = NaiveCache()
         tok = engine.tokenizer
         self.template = ChatTemplate(template_type, tok.chat_template, tok.eos_piece())
         self.default_sampler = default_sampler
+        self.device_loop_chunk = device_loop_chunk
         self.model_name = "distributed-llama-tpu"
 
 
@@ -133,9 +134,10 @@ def run_completion(state: ApiState, body: dict, emit):
     streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
 
     try:
-        out, _stats = engine.generate(delta_prompt, max_tokens, sampler,
-                                      on_token=streamer.on_token,
-                                      stop_check=streamer.stop_check)
+        out, _stats = engine.generate_with(delta_prompt, max_tokens, sampler,
+                                           on_token=streamer.on_token,
+                                           stop_check=streamer.stop_check,
+                                           device_loop_chunk=state.device_loop_chunk)
     except Exception:
         # KV may hold a half-written new conversation; drop the reuse index entirely
         state.cache.update([])
@@ -229,9 +231,11 @@ class Handler(BaseHTTPRequestHandler):
 
 def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           template_type: TemplateType = TemplateType.UNKNOWN,
-          default_sampler: Sampler | None = None) -> ThreadingHTTPServer:
+          default_sampler: Sampler | None = None,
+          device_loop_chunk: int = 0) -> ThreadingHTTPServer:
     state = ApiState(engine, template_type,
-                     default_sampler or Sampler(engine.spec.vocab_size, 0.7, 0.9, 0))
+                     default_sampler or Sampler(engine.spec.vocab_size, 0.7, 0.9, 0),
+                     device_loop_chunk)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = ThreadingHTTPServer((host, port), handler)
     print(f"🟢 dllama-api listening on {host}:{port}")
@@ -239,6 +243,9 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
 
 
 def main(argv=None) -> None:
+    from ..platform_env import apply_platform_env
+
+    apply_platform_env()
     from .dllama import build_parser, make_engine, make_sampler
 
     p = build_parser(include_mode=False)
@@ -249,7 +256,7 @@ def main(argv=None) -> None:
     sampler = make_sampler(args, engine.spec)
     server = serve(engine, args.host, args.port,
                    TemplateType(args.chat_template) if args.chat_template
-                   else TemplateType.UNKNOWN, sampler)
+                   else TemplateType.UNKNOWN, sampler, args.device_loop)
     server.serve_forever()
 
 

@@ -45,6 +45,8 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                    choices=["f32", "f16", "q40", "q80"],
                    help="q80 enables int8-compressed collectives (wire compression)")
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel devices")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel devices (ring attention over the KV cache)")
     p.add_argument("--dtype", default="auto", choices=["auto", "float32", "bfloat16"],
                    help="auto = bfloat16 on TPU, float32 on CPU")
     p.add_argument("--no-pallas", action="store_true")
@@ -68,7 +70,7 @@ def make_engine(args) -> Engine:
     engine = Engine.load(
         args.model, args.tokenizer, max_seq_len=args.max_seq_len,
         weights_ftype=_FT[args.weights_float_type] if args.weights_float_type else None,
-        tp=args.tp,
+        tp=args.tp, sp=args.sp,
         dtype=(None if args.dtype == "auto"
                else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
         use_pallas=False if args.no_pallas else None,
@@ -101,11 +103,8 @@ def mode_inference(args) -> None:
         piece = tok.decode_piece(prompt[-1] if not pieces else 0, t)
         pieces.append(piece)
 
-    if args.device_loop:
-        out, stats = engine.generate_chunked(prompt, args.steps, sampler,
-                                             on_token=on_token, chunk=args.device_loop)
-    else:
-        out, stats = engine.generate(prompt, args.steps, sampler, on_token=on_token)
+    out, stats = engine.generate_with(prompt, args.steps, sampler, on_token=on_token,
+                                      device_loop_chunk=args.device_loop)
     text = b"".join(pieces).decode("utf-8", errors="replace")
     print(text)
     # per-token stats table like dllama.cpp:76-93
@@ -133,10 +132,9 @@ def mode_generate(args) -> None:
         sys.stdout.flush()
         prev = t
 
-    gen = engine.generate_chunked if args.device_loop else engine.generate
-    kw = {"chunk": args.device_loop} if args.device_loop else {}
-    gen(prompt, args.steps, sampler, on_token=on_token,
-        stop_check=lambda t: t == tok.eos_id, **kw)
+    engine.generate_with(prompt, args.steps, sampler, on_token=on_token,
+                         stop_check=lambda t: t == tok.eos_id,
+                         device_loop_chunk=args.device_loop)
     print()
 
 
@@ -175,14 +173,19 @@ def mode_chat(args) -> None:
             sys.stdout.flush()
 
         streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit)
-        engine.generate(prompt, engine.spec.seq_len - engine.pos - 1, sampler,
-                        on_token=streamer.on_token, stop_check=streamer.stop_check)
+        engine.generate_with(prompt, engine.spec.seq_len - engine.pos - 1, sampler,
+                             on_token=streamer.on_token,
+                             stop_check=streamer.stop_check,
+                             device_loop_chunk=args.device_loop)
         if engine.pos >= engine.spec.seq_len - 1:
             print("\n(context end reached)")
             break
 
 
 def main(argv=None) -> None:
+    from ..platform_env import apply_platform_env
+
+    apply_platform_env()
     args = build_parser().parse_args(argv)
     {"inference": mode_inference, "generate": mode_generate, "chat": mode_chat}[args.mode](args)
 

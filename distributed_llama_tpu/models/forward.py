@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import gelu_tanh, rmsnorm, silu
 from ..ops.matmul import qmatmul
+from ..ops.ring_attention import ring_attention, update_kv_cache_sharded
 from ..ops.rope import RopeTables, apply_rope
 from .spec import ArchType, HiddenAct, ModelSpec
 
@@ -58,8 +59,9 @@ def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> 
 
 
 def _attention(x, bp, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos, positions,
-               axis_name, use_pallas, compress):
-    """Sharded attention sub-block. Head counts in bp may be TP-local slices."""
+               axis_name, sp_axis_name, sp_size, use_pallas, compress):
+    """Sharded attention sub-block. Head counts in bp may be TP-local slices; the cache
+    sequence axis may be sp-sharded (ring attention)."""
     b, t, _ = x.shape
     hs = spec.head_size
     xb = rmsnorm(x, bp["rms_att"], spec.norm_eps)
@@ -71,8 +73,16 @@ def _attention(x, bp, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos, posi
     q = apply_rope(q.reshape(b, t, hq_local, hs), rope, positions)
     k = apply_rope(k.reshape(b, t, hk_local, hs), rope, positions)
     v = v.reshape(b, t, hk_local, hs)
-    kc, vc = update_kv_cache(kc, vc, k, v, start_pos)
-    att = gqa_attention(q, kc, vc, positions)
+    if sp_axis_name is not None and sp_size > 1:
+        # sequence parallelism: each sp member keeps its slice of the cache and the
+        # KV blocks rotate around the ring (ops/ring_attention.py)
+        kc, vc = update_kv_cache_sharded(kc, vc, k, v, start_pos,
+                                         axis_name=sp_axis_name)
+        att = ring_attention(q, kc, vc, positions, axis_name=sp_axis_name,
+                             axis_size=sp_size)
+    else:
+        kc, vc = update_kv_cache(kc, vc, k, v, start_pos)
+        att = gqa_attention(q, kc, vc, positions)
     # col-parallel wo: local heads x local input slice -> partial (B, T, dim); psum merges
     attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas), axis_name, compress)
     return attn_out, kc, vc
@@ -138,11 +148,12 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
 
 
 def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions,
-           axis_name, use_pallas, compress):
+           axis_name, sp_axis_name, sp_size, use_pallas, compress):
     x = carry
     bp, kc, vc = layer
     attn_out, kc, vc = _attention(x, bp, spec, rope, kc, vc, start_pos, positions,
-                                  axis_name, use_pallas, compress)
+                                  axis_name, sp_axis_name, sp_size, use_pallas,
+                                  compress)
     if spec.arch_type == ArchType.GROK1:
         # grok: residual-join the *normalized* attention output (grokRmfFfn/Norm/Join)
         x = x + rmsnorm(attn_out, bp["rms_ffn"], spec.norm_eps)
@@ -162,6 +173,7 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
 def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             tokens: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             start_pos: jax.Array, *, dtype=jnp.float32, axis_name: str | None = None,
+            sp_axis_name: str | None = None, sp_size: int = 1,
             use_pallas: bool = False, compress_collectives: bool = False):
     """Run T tokens through the model against the KV cache.
 
@@ -179,6 +191,7 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
 
     block_fn = functools.partial(_block, spec=spec, rope=rope, start_pos=start_pos,
                                  positions=positions, axis_name=axis_name,
+                                 sp_axis_name=sp_axis_name, sp_size=sp_size,
                                  use_pallas=use_pallas, compress=compress_collectives)
     x, (k_cache, v_cache) = jax.lax.scan(block_fn, x,
                                          (params["blocks"], k_cache, v_cache))

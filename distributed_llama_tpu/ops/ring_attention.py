@@ -1,0 +1,130 @@
+"""Ring attention: causal GQA attention over a sequence-sharded KV cache.
+
+Long-context sequence parallelism — absent from the reference, which keeps the FULL
+seqLen KV slice resident per node and only shards heads (SURVEY.md §5: KvCacheSlice,
+src/commands.cpp:97-102, per-head quadratic loop llama2-tasks.cpp:62-93). Here the cache's
+sequence axis is sharded over the mesh's `sp` axis, so max context scales linearly with
+devices; each device attends its local KV block, and the blocks rotate around the ring
+with `ppermute` while a numerically stable online softmax (flash-attention-style
+m/denominator carry) accumulates the output. Compute and ICI transfer overlap: while a
+device contracts block r it can already be sending/receiving block r+1.
+
+Every device holds the full Q (queries are small; KV is what grows with context), so the
+output is replicated over sp and no final gather is needed. Combines with TP head
+sharding orthogonally: cache is (B, hk/tp, S/sp, hs) on a (dp, sp, tp) mesh.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _block_attend(qg, k_blk, v_blk, positions, global_start):
+    """Masked scores + unnormalized accumulation for one KV block.
+
+    qg: (B, hk, g, T, hs) f32; k_blk/v_blk: (B, hk, Sb, hs); positions: (T,) absolute
+    query positions; global_start: absolute position of the block's first column.
+    Returns (m (…, T), l (…, T), acc (…, T, hs)) partial softmax stats.
+    """
+    sb = k_blk.shape[2]
+    hs = qg.shape[-1]
+    scale = 1.0 / math.sqrt(hs)
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg,
+                        k_blk.astype(jnp.float32)) * scale  # (B, hk, g, T, Sb)
+    col_pos = global_start + jnp.arange(sb)  # absolute positions of block columns
+    valid = col_pos[None, :] <= positions[:, None]  # (T, Sb) causal
+    scores = jnp.where(valid[None, None, None], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)  # (B, hk, g, T)
+    # guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1, so clamp m
+    safe_m = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(valid[None, None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgts,bksd->bkgtd", p, v_blk.astype(jnp.float32))
+    return m, l, acc
+
+
+def _combine(m1, l1, acc1, m2, l2, acc2):
+    """Merge two partial softmax accumulations (flash-attention combine)."""
+    m = jnp.maximum(m1, m2)
+    safe_m = jnp.maximum(m, _NEG_INF / 2)
+    a1 = jnp.exp(m1 - safe_m)
+    a2 = jnp.exp(m2 - safe_m)
+    return m, l1 * a1 + l2 * a2, acc1 * a1[..., None] + acc2 * a2[..., None]
+
+
+def ring_attention(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
+                   positions: jax.Array, *, axis_name: str, axis_size: int) -> jax.Array:
+    """Causal GQA attention of T query tokens against a sequence-sharded cache.
+
+    q: (B, T, hq, hs) replicated over sp; k_shard/v_shard: (B, hk, S/sp, hs), the local
+    sequence shard (device i holds absolute positions [i*Sb, (i+1)*Sb)). Returns
+    (B, T, hq*hs), replicated over sp.
+    """
+    b, t, hq, hs = q.shape
+    _, hk, sb, _ = k_shard.shape
+    g = hq // hk
+    # (B, hk, g, T, hs) — block-attend subscripts are head-major
+    qg = jnp.moveaxis(q.reshape(b, t, hk, g, hs), 1, 3).astype(jnp.float32)
+
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]  # send left, recv right
+
+    m = jnp.full((b, hk, g, t), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hk, g, t), jnp.float32)
+    acc = jnp.zeros((b, hk, g, t, hs), jnp.float32)
+    k_blk, v_blk = k_shard, v_shard
+    for r in range(axis_size):
+        owner = (idx + r) % axis_size  # whose shard I currently hold
+        mb, lb, ab = _block_attend(qg, k_blk, v_blk, positions, owner * sb)
+        m, l, acc = _combine(m, l, acc, mb, lb, ab)
+        if r + 1 < axis_size:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, hk, g, T, hs)
+    out = jnp.moveaxis(out, 3, 1)  # (B, T, hk, g, hs)
+    return out.reshape(b, t, hq * hs).astype(q.dtype)
+
+
+def update_kv_cache_sharded(k_cache: jax.Array, v_cache: jax.Array, k_new: jax.Array,
+                            v_new: jax.Array, start_pos: jax.Array, *,
+                            axis_name: str) -> tuple[jax.Array, jax.Array]:
+    """Write T new kv vectors into sequence-sharded caches; each sp member keeps only
+    the positions that land in its shard.
+
+    k_new/v_new: (B, T, hk, hs); caches: (B, hk, Sb, hs) local shards. The write may
+    straddle a shard boundary, so it is a masked positional update. Replaces
+    ops.attention.update_kv_cache when the cache's S axis is sp-sharded.
+    """
+    b, t, hk, hs = k_new.shape
+    sb = k_cache.shape[2]
+    idx = jax.lax.axis_index(axis_name)
+    local = start_pos - idx * sb  # where the chunk starts in MY shard (may be <0)
+
+    if t == 1:
+        in_range = (local >= 0) & (local < sb)
+        at = jnp.clip(local, 0, sb - 1)
+        def write(cache, new):
+            new_t = jnp.swapaxes(new, 1, 2).astype(cache.dtype)  # (B, hk, 1, hs)
+            cur = jax.lax.dynamic_slice(cache, (0, 0, at, 0), new_t.shape)
+            val = jnp.where(in_range, new_t, cur)
+            return jax.lax.dynamic_update_slice(cache, val, (0, 0, at, 0))
+        return write(k_cache, k_new), write(v_cache, v_new)
+
+    # chunk write, possibly straddling shards: scatter by position mask over the shard
+    slot = jnp.arange(sb)  # local slots
+    src = slot - local  # which chunk token lands in this slot
+    hit = (src >= 0) & (src < t)  # (Sb,)
+    src_c = jnp.clip(src, 0, t - 1)
+
+    def write(cache, new):
+        new_t = jnp.swapaxes(new, 1, 2).astype(cache.dtype)  # (B, hk, T, hs)
+        gathered = jnp.take(new_t, src_c, axis=2)  # (B, hk, Sb, hs)
+        return jnp.where(hit[None, None, :, None], gathered, cache)
+
+    return write(k_cache, k_new), write(v_cache, v_new)

@@ -63,7 +63,14 @@ def kv_cache_pspec(seq_axis: str | None = None) -> P:
     return P(None, None, AXIS_TP, seq_axis)
 
 
-def check_divisibility(spec: ModelSpec, tp: int) -> None:
+def kv_cache_pspec_for_mesh(mesh) -> P:
+    """Cache pspec for a mesh: sequence axis sharded iff the mesh has sp > 1."""
+    from .mesh import AXIS_SP
+
+    return kv_cache_pspec(AXIS_SP if mesh.shape.get(AXIS_SP, 1) > 1 else None)
+
+
+def check_divisibility(spec: ModelSpec, tp: int, sp: int = 1) -> None:
     """The reference's hard constraint nSlices <= nKvHeads (transformer.cpp:108-111),
     plus even-division checks that replace its 2^n assumption."""
     assert spec.n_kv_heads % tp == 0, (
@@ -74,3 +81,5 @@ def check_divisibility(spec: ModelSpec, tp: int) -> None:
     assert spec.vocab_size % tp == 0
     if (spec.dim // tp) % 32 or (spec.hidden_dim // tp) % 32:
         raise AssertionError("tp slice must keep 32-wide quant blocks intact")
+    assert spec.seq_len % sp == 0, (
+        f"sp={sp} must divide seq_len={spec.seq_len} (sequence-sharded KV cache)")

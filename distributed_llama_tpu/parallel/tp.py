@@ -20,8 +20,8 @@ from ..models.forward import forward
 from ..models.spec import ModelSpec
 from ..ops.rope import RopeTables
 from ..quants import QTensor
-from .mesh import AXIS_TP
-from .sharding import check_divisibility, kv_cache_pspec, param_pspecs
+from .mesh import AXIS_SP, AXIS_TP
+from .sharding import check_divisibility, kv_cache_pspec_for_mesh, param_pspecs
 
 
 def _expand_pspec_tree(params: dict[str, Any], pspecs: dict[str, Any]):
@@ -66,13 +66,15 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
     import jax.numpy as jnp
 
     tp = mesh.shape[AXIS_TP]
-    check_divisibility(spec, tp)
+    sp = mesh.shape.get(AXIS_SP, 1)
+    check_divisibility(spec, tp, sp)
     dtype = dtype or jnp.float32
 
     param_specs = _expand_pspec_tree(params, param_pspecs(params))
-    kv_spec = kv_cache_pspec()
+    kv_spec = kv_cache_pspec_for_mesh(mesh)
 
     fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+                            sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives)
     rope_type = spec.rope_type

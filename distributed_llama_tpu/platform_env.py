@@ -1,0 +1,19 @@
+"""Honor the caller's JAX platform choice even when jax was pre-imported.
+
+Some launch environments (e.g. the axon TPU tunnel) import jax from sitecustomize at
+interpreter startup, freezing its snapshot of JAX_PLATFORMS before application code
+runs. Entry points call apply_platform_env() first so an explicit
+`JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N` (the virtual
+CPU mesh used for multi-device runs without a pod) actually takes effect.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

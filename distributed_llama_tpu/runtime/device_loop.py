@@ -36,8 +36,8 @@ from jax.sharding import PartitionSpec as P
 from ..models.forward import forward
 from ..models.spec import ModelSpec
 from ..ops.rope import RopeTables
-from ..parallel.mesh import AXIS_TP
-from ..parallel.sharding import kv_cache_pspec, param_pspecs
+from ..parallel.mesh import AXIS_SP, AXIS_TP
+from ..parallel.sharding import kv_cache_pspec_for_mesh, param_pspecs
 from ..parallel.tp import _expand_pspec_tree
 
 
@@ -93,11 +93,13 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     """
     assert mode in ("greedy", "sample"), mode
     dtype = dtype or jnp.float32
+    sp = mesh.shape.get(AXIS_SP, 1)
     param_specs = _expand_pspec_tree(params, param_pspecs(params))
-    kv_spec = kv_cache_pspec()
+    kv_spec = kv_cache_pspec_for_mesh(mesh)
     rope_type = spec.rope_type
 
     fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+                            sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives)
 
@@ -105,7 +107,7 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
         rope = RopeTables(rope_cos, rope_sin, rope_type)
 
         def step(carry, i):
-            token, kc, vc = carry
+            token, row0, kc, vc = carry
             logits, kc, vc = fwd(p, rope=rope, tokens=token[None, None],
                                  k_cache=kc, v_cache=vc, start_pos=start_pos + i)
             row = logits[0, -1].astype(jnp.float32)
@@ -113,11 +115,12 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
                 nxt = jnp.argmax(row).astype(jnp.int32)
             else:
                 nxt = device_sample(row, jax.random.fold_in(key, i), temperature, topp)
-            return (nxt, kc, vc), (nxt, row)
+            return (nxt, row, kc, vc), nxt
 
-        (tok, kc, vc), (tokens, rows) = jax.lax.scan(
-            step, (token, kc, vc), jnp.arange(n_steps, dtype=jnp.int32))
-        return tokens, rows[-1], kc, vc
+        row0 = jnp.zeros((spec.vocab_size,), jnp.float32)
+        (tok, row, kc, vc), tokens = jax.lax.scan(
+            step, (token, row0, kc, vc), jnp.arange(n_steps, dtype=jnp.int32))
+        return tokens, row, kc, vc
 
     sharded = jax.shard_map(
         loop, mesh=mesh,

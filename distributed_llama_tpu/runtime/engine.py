@@ -77,7 +77,8 @@ def collective_kbytes_per_token(spec: ModelSpec, tp: int, compress: bool) -> flo
 
 class Engine:
     def __init__(self, spec: ModelSpec, params: Params, tokenizer: Tokenizer | None = None,
-                 *, tp: int | None = None, dtype=None, use_pallas: bool | None = None,
+                 *, tp: int | None = None, sp: int = 1, dtype=None,
+                 use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1):
         self.spec = spec
         self.tokenizer = tokenizer
@@ -90,8 +91,9 @@ class Engine:
         self.compress = compress_collectives
         if use_pallas is None:
             use_pallas = on_tpu
-        self.mesh = make_mesh(tp=tp)
+        self.mesh = make_mesh(tp=tp, sp=sp)
         self.tp = self.mesh.shape[AXIS_TP]
+        self.sp = sp
         has_quant = any(
             getattr(t, "ftype", None) in (FloatType.Q40, FloatType.Q80)
             for t in params["blocks"].values())
@@ -125,9 +127,9 @@ class Engine:
         kc, vc = init_kv_cache(self.spec, batch=self.batch, dtype=self.dtype)
         from jax.sharding import NamedSharding
 
-        from ..parallel.sharding import kv_cache_pspec
+        from ..parallel.sharding import kv_cache_pspec_for_mesh
 
-        sh = NamedSharding(self.mesh, kv_cache_pspec())
+        sh = NamedSharding(self.mesh, kv_cache_pspec_for_mesh(self.mesh))
         return jax.device_put(kc, sh), jax.device_put(vc, sh)
 
     def reset(self) -> None:
@@ -197,6 +199,16 @@ class Engine:
             stats.token_ms.append((t2 - t0) * 1000.0)
         return out, stats
 
+    def generate_with(self, prompt_tokens: list[int], max_tokens: int, sampler,
+                      *, device_loop_chunk: int = 0, **kw
+                      ) -> tuple[list[int], GenerationStats]:
+        """generate / generate_chunked dispatch: chunk > 0 selects the on-device scan
+        loop. The single switch point for every app surface's --device-loop flag."""
+        if device_loop_chunk > 0:
+            return self.generate_chunked(prompt_tokens, max_tokens, sampler,
+                                         chunk=device_loop_chunk, **kw)
+        return self.generate(prompt_tokens, max_tokens, sampler, **kw)
+
     # ------------------------------------------------------------------
     # device-loop generation (one dispatch per chunk of tokens)
     # ------------------------------------------------------------------
@@ -228,7 +240,8 @@ class Engine:
         if len(prompt_tokens) > 1:
             self.prefill(prompt_tokens[:-1], stats)
         stats.prompt_tokens = len(prompt_tokens)
-        key = jax.random.PRNGKey(int(getattr(sampler, "state", 0)))
+        # sampler.state is a full-range uint64 (xorshift*); PRNGKey takes an int64
+        key = jax.random.PRNGKey(int(getattr(sampler, "state", 0)) & (2**63 - 1))
         temperature = getattr(sampler, "temperature", 0.0)
         topp = getattr(sampler, "topp", 0.9)
         out: list[int] = []
