@@ -1,0 +1,158 @@
+"""ctypes bindings for the native host-runtime library (src/dlt_native.cpp).
+
+Compiled on first use with the system toolchain (g++, no pip packages) and cached next
+to the source; every entry point has a pure-Python/numpy fallback, so `available()`
+returning False only means slower loads/encodes, never missing functionality. The split
+mirrors the reference, where the host runtime (weight streaming transformer.cpp,
+tokenizer.cpp) is C++ while we keep the accelerator math in XLA/Pallas.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "dlt_native.cpp")
+_SO = os.path.join(_DIR, "_build", "dlt_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None | bool = None  # None = not tried, False = unavailable
+
+
+def _build() -> str | None:
+    try:
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return _SO
+        # per-process temp name: concurrent first-use builds must not race on one
+        # .tmp path; os.replace promotion is atomic
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+               "-pthread", _SRC, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return _SO
+    except Exception:
+        return None
+
+
+def _load() -> ctypes.CDLL | bool:
+    so = _build()
+    if so is None:
+        return False
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return False
+    i64, u8p, u16p, i8p, f32p, i32p = (
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int8),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32))
+    lib.dlt_q40_deinterleave.argtypes = [u8p, i64, u8p, u16p]
+    lib.dlt_q80_deinterleave.argtypes = [u8p, i64, i8p, u16p]
+    lib.dlt_q40_to_i8.argtypes = [u8p, u16p, i64, i8p, f32p]
+    lib.dlt_f16_to_f32.argtypes = [u16p, i64, f32p]
+    lib.dlt_bpe_create.restype = ctypes.c_void_p
+    lib.dlt_bpe_create.argtypes = [u8p, ctypes.POINTER(i64), f32p, i64]
+    lib.dlt_bpe_destroy.argtypes = [ctypes.c_void_p]
+    lib.dlt_bpe_encode.restype = i64
+    lib.dlt_bpe_encode.argtypes = [ctypes.c_void_p, u8p, i64, i32p]
+    return lib
+
+
+def _get() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is None:
+        with _lock:
+            if _lib is None:
+                _lib = _load()
+    return _lib if _lib is not False else None
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def q40_deinterleave(buf, nb: int) -> tuple[np.ndarray, np.ndarray] | None:
+    """Interleaved Q40 block stream -> (qs (nb, 16) u8, deltas (nb,) f16)."""
+    lib = _get()
+    if lib is None:
+        return None
+    src = np.frombuffer(buf, dtype=np.uint8, count=nb * 18)
+    qs = np.empty((nb, 16), np.uint8)
+    d = np.empty((nb,), np.uint16)
+    lib.dlt_q40_deinterleave(_ptr(src, ctypes.c_uint8), nb,
+                             _ptr(qs, ctypes.c_uint8), _ptr(d, ctypes.c_uint16))
+    return qs, d.view(np.float16)
+
+
+def q80_deinterleave(buf, nb: int) -> tuple[np.ndarray, np.ndarray] | None:
+    lib = _get()
+    if lib is None:
+        return None
+    src = np.frombuffer(buf, dtype=np.uint8, count=nb * 34)
+    qs = np.empty((nb, 32), np.int8)
+    d = np.empty((nb,), np.uint16)
+    lib.dlt_q80_deinterleave(_ptr(src, ctypes.c_uint8), nb,
+                             _ptr(qs, ctypes.c_int8), _ptr(d, ctypes.c_uint16))
+    return qs, d.view(np.float16)
+
+
+def q40_to_i8(packed: np.ndarray, scales: np.ndarray
+              ) -> tuple[np.ndarray, np.ndarray] | None:
+    """Planar Q40 (..., nb, 16) u8 + (..., nb) f16 -> (int8 (..., nb*32), f32 scales)."""
+    lib = _get()
+    if lib is None:
+        return None
+    nb = int(np.prod(packed.shape[:-1], initial=1))
+    p = np.ascontiguousarray(packed).reshape(nb, 16)
+    d = np.ascontiguousarray(scales, dtype=np.float16).reshape(nb)
+    vals = np.empty((nb, 32), np.int8)
+    sc = np.empty((nb,), np.float32)
+    lib.dlt_q40_to_i8(_ptr(p, ctypes.c_uint8), _ptr(d.view(np.uint16), ctypes.c_uint16),
+                      nb, _ptr(vals, ctypes.c_int8), _ptr(sc, ctypes.c_float))
+    lead = packed.shape[:-2]
+    nbl = packed.shape[-2]
+    return vals.reshape(*lead, nbl * 32), sc.reshape(*lead, nbl)
+
+
+class NativeBPE:
+    """Native greedy-merge BPE encoder over a TokenizerData vocab."""
+
+    def __init__(self, vocab: list[bytes], scores: list[float]):
+        lib = _get()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        blob = b"".join(vocab)
+        offsets = np.zeros(len(vocab) + 1, np.int64)
+        np.cumsum([len(v) for v in vocab], out=offsets[1:])
+        self._blob = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+        self._scores = np.asarray(scores, np.float32)
+        self._handle = lib.dlt_bpe_create(
+            _ptr(self._blob, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+            _ptr(self._scores, ctypes.c_float), len(vocab))
+
+    def encode(self, raw: bytes) -> list[int] | None:
+        """Token ids, or None when the vocab can't byte-fallback this input (the
+        caller's Python path then reports the error)."""
+        n = len(raw)
+        src = np.frombuffer(raw, np.uint8) if n else np.zeros(1, np.uint8)
+        out = np.empty(n + 1, np.int32)
+        cnt = self._lib.dlt_bpe_encode(self._handle, _ptr(src, ctypes.c_uint8), n,
+                                       _ptr(out, ctypes.c_int32))
+        return out[:cnt].tolist() if cnt >= 0 else None
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_handle", None):
+            lib.dlt_bpe_destroy(self._handle)

@@ -1,0 +1,214 @@
+// Native host-runtime kernels for distributed_llama_tpu.
+//
+// The TPU owns the forward pass (XLA/Pallas), but the host runtime around it —
+// model-file decode and tokenization — is the same kind of work the reference
+// implements in C++ (src/transformer.cpp weight streaming, src/tokenizer.cpp BPE).
+// These are fresh implementations of this framework's own host formats, built as a
+// shared library loaded via ctypes (see native/__init__.py; every entry point has a
+// pure-numpy/Python fallback, so the library is an accelerator, not a dependency).
+//
+// Contents:
+//   - f16 -> f32 scalar conversion (scale decode)
+//   - Q40/Q80 interleaved block streams -> planar arrays (the .m tensor layout,
+//     reference struct layout quants.hpp:17-25)
+//   - Q40 planar -> int8 planes (the Pallas q8 kernel's on-device layout,
+//     ops/pallas_q8.py)
+//   - llama2.c-style BPE encoder (greedy highest-score pair merging, byte fallback;
+//     behavior-parity with tokenizer/bpe.py which itself mirrors src/tokenizer.cpp)
+//
+// All bulk transforms are threaded over block ranges with std::thread.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int QK = 32;
+
+float f16_to_f32(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t mant = h & 0x3FF;
+    uint32_t bits;
+    if (exp == 0) {
+        if (mant == 0) {
+            bits = sign;  // +-0
+        } else {  // subnormal: normalize
+            int shift = 0;
+            while (!(mant & 0x400)) { mant <<= 1; ++shift; }
+            mant &= 0x3FF;
+            bits = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
+        }
+    } else if (exp == 31) {
+        bits = sign | 0x7F800000u | (mant << 13);  // inf/nan
+    } else {
+        bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+    }
+    float out;
+    std::memcpy(&out, &bits, sizeof(out));
+    return out;
+}
+
+template <typename F>
+void parallel_blocks(int64_t n, F body) {
+    unsigned hw = std::thread::hardware_concurrency();
+    int64_t nthreads = (int64_t)(hw ? hw : 4);
+    if (nthreads > n / 4096) nthreads = n / 4096;  // don't spawn for tiny work
+    if (nthreads <= 1) { body((int64_t)0, n); return; }
+    std::vector<std::thread> ts;
+    int64_t per = (n + nthreads - 1) / nthreads;
+    for (int64_t t = 0; t < nthreads; ++t) {
+        int64_t lo = t * per, hi = lo + per < n ? lo + per : n;
+        if (lo >= hi) break;
+        ts.emplace_back([=] { body(lo, hi); });
+    }
+    for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Q40 interleaved stream (18 B/block: f16 delta + 16 nibble-pair bytes) ->
+// planar qs (nb, 16) u8 + deltas (nb,) f16 (raw u16 bits, converted later or not).
+void dlt_q40_deinterleave(const uint8_t* blocks, int64_t nb, uint8_t* qs_out,
+                          uint16_t* d_out) {
+    parallel_blocks(nb, [=](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) {
+            const uint8_t* b = blocks + i * 18;
+            std::memcpy(d_out + i, b, 2);
+            std::memcpy(qs_out + i * 16, b + 2, 16);
+        }
+    });
+}
+
+// Q80 interleaved stream (34 B/block: f16 delta + 32 int8) -> planar.
+void dlt_q80_deinterleave(const uint8_t* blocks, int64_t nb, int8_t* qs_out,
+                          uint16_t* d_out) {
+    parallel_blocks(nb, [=](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) {
+            const uint8_t* b = blocks + i * 34;
+            std::memcpy(d_out + i, b, 2);
+            std::memcpy(qs_out + i * QK, b + 2, QK);
+        }
+    });
+}
+
+// Planar Q40 (nb, 16) u8 + f16 deltas -> int8 planes (nb*32,) natural order
+// (block b: cols [b*32, b*32+16) = low nibbles - 8, [b*32+16, b*32+32) = high - 8)
+// + f32 scales. This is QTensor.to_i8_layout's hot loop.
+void dlt_q40_to_i8(const uint8_t* packed, const uint16_t* d16, int64_t nb,
+                   int8_t* vals_out, float* scales_out) {
+    parallel_blocks(nb, [=](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) {
+            const uint8_t* q = packed + i * 16;
+            int8_t* v = vals_out + i * QK;
+            for (int j = 0; j < 16; ++j) {
+                v[j] = (int8_t)(q[j] & 0x0F) - 8;
+                v[j + 16] = (int8_t)(q[j] >> 4) - 8;
+            }
+            scales_out[i] = f16_to_f32(d16[i]);
+        }
+    });
+}
+
+// f16 bits -> f32 array (Q80 scale decode and general .m f16 tensors).
+void dlt_f16_to_f32(const uint16_t* in, int64_t n, float* out) {
+    parallel_blocks(n, [=](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) out[i] = f16_to_f32(in[i]);
+    });
+}
+
+// ---------------------------------------------------------------------------
+// BPE encoder (behavior-parity with tokenizer/bpe.py <- src/tokenizer.cpp:170-292)
+// ---------------------------------------------------------------------------
+
+struct DltBpe {
+    std::vector<std::string> vocab;
+    std::vector<float> scores;
+    std::unordered_map<std::string, int32_t> lookup;  // first occurrence wins
+    int32_t space_id = -1;
+};
+
+void* dlt_bpe_create(const uint8_t* blob, const int64_t* offsets,
+                     const float* scores, int64_t n) {
+    auto* h = new DltBpe();
+    h->vocab.reserve(n);
+    h->scores.assign(scores, scores + n);
+    h->lookup.reserve((size_t)n * 2);
+    for (int64_t i = 0; i < n; ++i) {
+        std::string piece((const char*)(blob + offsets[i]),
+                          (size_t)(offsets[i + 1] - offsets[i]));
+        h->vocab.push_back(piece);
+        h->lookup.emplace(std::move(piece), (int32_t)i);  // keeps first duplicate
+    }
+    auto it = h->lookup.find(" ");
+    if (it != h->lookup.end()) h->space_id = it->second;
+    return h;
+}
+
+void dlt_bpe_destroy(void* hp) { delete (DltBpe*)hp; }
+
+// Encode raw bytes (no BOS/EOS — the Python wrapper owns those) into out;
+// returns the token count. out must hold >= text_len + 1 entries.
+int64_t dlt_bpe_encode(void* hp, const uint8_t* text, int64_t text_len,
+                       int32_t* out) {
+    auto* h = (DltBpe*)hp;
+    std::vector<int32_t> toks;
+    toks.reserve((size_t)text_len + 1);
+    if (text_len > 0 && h->space_id >= 0) toks.push_back(h->space_id);  // dummy prefix
+
+    // UTF-8 codepoint chunking with byte fallback (+3 offset). A fallback id past the
+    // vocab (non-llama2.c vocab layout) would read out of bounds in the merge loop
+    // below — return -1 and let the Python wrapper take its (cleanly raising) path.
+    const int32_t n_vocab = (int32_t)h->vocab.size();
+    int64_t i = 0;
+    std::string chunk;
+    while (i < text_len) {
+        int64_t j = i + 1;
+        while (j < text_len && (text[j] & 0xC0) == 0x80 && (j - i) < 4) ++j;
+        chunk.assign((const char*)(text + i), (size_t)(j - i));
+        auto it = h->lookup.find(chunk);
+        if (it != h->lookup.end()) {
+            toks.push_back(it->second);
+        } else {
+            for (int64_t b = i; b < j; ++b) {
+                int32_t id = (int32_t)text[b] + 3;
+                if (id >= n_vocab) return -1;
+                toks.push_back(id);
+            }
+        }
+        i = j;
+    }
+
+    // greedy highest-score adjacent pair merging
+    std::string merged;
+    while (true) {
+        float best_score = -1e10f;
+        int32_t best_id = -1;
+        int64_t best_idx = -1;
+        for (int64_t k = 0; k + 1 < (int64_t)toks.size(); ++k) {
+            merged = h->vocab[(size_t)toks[k]];
+            merged += h->vocab[(size_t)toks[k + 1]];
+            auto it = h->lookup.find(merged);
+            if (it != h->lookup.end() && h->scores[(size_t)it->second] > best_score) {
+                best_score = h->scores[(size_t)it->second];
+                best_id = it->second;
+                best_idx = k;
+            }
+        }
+        if (best_idx < 0) break;
+        toks[(size_t)best_idx] = best_id;
+        toks.erase(toks.begin() + best_idx + 1);
+    }
+
+    std::memcpy(out, toks.data(), toks.size() * sizeof(int32_t));
+    return (int64_t)toks.size();
+}
+
+}  // extern "C"

@@ -108,6 +108,12 @@ def q40_from_bytes(buf: bytes, shape: tuple[int, ...]) -> tuple[np.ndarray, np.n
     assert n % QK == 0, shape
     nb_shape = (*shape[:-1], n // QK)
     nb = int(np.prod(nb_shape))
+    from . import native
+
+    nat = native.q40_deinterleave(buf, nb)
+    if nat is not None:
+        qs, d = nat
+        return qs.reshape(*nb_shape, QK // 2), d.reshape(nb_shape)
     arr = np.frombuffer(buf, dtype=_Q40_STRUCT, count=nb)
     return arr["qs"].reshape(*nb_shape, QK // 2).copy(), arr["d"].reshape(nb_shape).copy()
 
@@ -152,6 +158,12 @@ def q80_from_bytes(buf: bytes, shape: tuple[int, ...]) -> tuple[np.ndarray, np.n
     assert n % QK == 0, shape
     nb_shape = (*shape[:-1], n // QK)
     nb = int(np.prod(nb_shape))
+    from . import native
+
+    nat = native.q80_deinterleave(buf, nb)
+    if nat is not None:
+        qs, d = nat
+        return qs.reshape(*nb_shape, QK), d.reshape(nb_shape)
     arr = np.frombuffer(buf, dtype=_Q80_STRUCT, count=nb)
     return arr["qs"].reshape(*nb_shape, QK).copy(), arr["d"].reshape(nb_shape).copy()
 
@@ -259,6 +271,11 @@ class QTensor:
         """
         assert self.layout == "planar", self.layout
         if self.ftype == FloatType.Q40:
+            from . import native
+
+            nat = native.q40_to_i8(np.asarray(self.data), np.asarray(self.scales))
+            if nat is not None:
+                return QTensor(self.ftype, nat[0], nat[1], layout="i8")
             packed = np.asarray(self.data)
             lo = (packed & 0x0F).astype(np.int8) - 8  # elements 0..15 of each block
             hi = (packed >> 4).astype(np.int8) - 8  # elements 16..31

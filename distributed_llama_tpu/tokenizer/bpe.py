@@ -39,6 +39,8 @@ class Tokenizer:
             m = _BYTE_TOKEN_RE.match(piece)
             if m:
                 self._byte_pieces[i] = bytes([int(m.group(1), 16)])
+        self._native = None
+        self._native_tried = False
 
     @classmethod
     def load(cls, path: str) -> "Tokenizer":
@@ -66,6 +68,19 @@ class Tokenizer:
             stops.append(self.chat_stop.encode())
         return stops
 
+    def _native_bpe(self):
+        """Lazily build the C++ encoder (native/); None if the library is unavailable."""
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from .. import native
+
+                if native.available():
+                    self._native = native.NativeBPE(self.vocab, self.scores)
+            except Exception:
+                self._native = None
+        return self._native
+
     def encode(self, text: str | bytes, add_bos: bool = False,
                add_eos: bool = False) -> list[int]:
         """Reference Tokenizer::encode (tokenizer.cpp:170-292)."""
@@ -73,6 +88,14 @@ class Tokenizer:
         tokens: list[int] = []
         if add_bos and self.bos_id >= 0:
             tokens.append(self.bos_id)
+        nat = self._native_bpe()
+        if nat is not None:
+            ids = nat.encode(raw)
+            if ids is not None:
+                tokens.extend(ids)
+                if add_eos and self.eos_id >= 0:
+                    tokens.append(self.eos_id)
+                return tokens
         if raw:
             dummy = self._lookup.get(b" ")
             if dummy is not None:

@@ -1,0 +1,98 @@
+"""Native (C++) host-runtime library tests: must agree exactly with the numpy/Python
+fallbacks, which the format/tokenizer golden tests tie to the reference encoding."""
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu import native
+from distributed_llama_tpu.formats.tfile import TokenizerData
+from distributed_llama_tpu.quants import (
+    _Q40_STRUCT,
+    _Q80_STRUCT,
+    FloatType,
+    QTensor,
+    quantize_q40,
+    quantize_q80,
+)
+from distributed_llama_tpu.tokenizer.bpe import Tokenizer
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_q40_deinterleave_matches_numpy():
+    rng = np.random.RandomState(0)
+    packed, scales = quantize_q40(rng.randn(8, 256).astype(np.float32))
+    nb = packed.shape[0] * packed.shape[1]
+    out = np.empty(nb, dtype=_Q40_STRUCT)
+    out["d"] = scales.reshape(nb)
+    out["qs"] = packed.reshape(nb, 16)
+    buf = out.tobytes()
+
+    qs, d = native.q40_deinterleave(buf, nb)
+    np.testing.assert_array_equal(qs, packed.reshape(nb, 16))
+    np.testing.assert_array_equal(d, scales.reshape(nb))
+
+
+def test_q80_deinterleave_matches_numpy():
+    rng = np.random.RandomState(1)
+    vals, scales = quantize_q80(rng.randn(4, 320).astype(np.float32))
+    nb = vals.shape[0] * vals.shape[1]
+    out = np.empty(nb, dtype=_Q80_STRUCT)
+    out["d"] = scales.reshape(nb)
+    out["qs"] = vals.reshape(nb, 32)
+    buf = out.tobytes()
+
+    qs, d = native.q80_deinterleave(buf, nb)
+    np.testing.assert_array_equal(qs, vals.reshape(nb, 32))
+    np.testing.assert_array_equal(d, scales.reshape(nb))
+
+
+def test_q40_to_i8_matches_python():
+    rng = np.random.RandomState(2)
+    w = QTensor.from_float(rng.randn(16, 512).astype(np.float32), FloatType.Q40)
+    got = native.q40_to_i8(np.asarray(w.data), np.asarray(w.scales))
+    assert got is not None
+    vals, scales = got
+
+    # force the numpy fallback by computing it inline
+    packed = np.asarray(w.data)
+    lo = (packed & 0x0F).astype(np.int8) - 8
+    hi = (packed >> 4).astype(np.int8) - 8
+    want_vals = np.concatenate([lo, hi], axis=-1).reshape(16, 512)
+    np.testing.assert_array_equal(vals, want_vals)
+    np.testing.assert_allclose(scales, np.asarray(w.scales, np.float32), rtol=0,
+                               atol=0)
+
+
+def test_f16_scale_conversion_exact():
+    """f16->f32 in C++ must match numpy bit-for-bit, incl. subnormals and zeros."""
+    specials = np.asarray([0.0, -0.0, 1.0, -1.5, 6.1e-5, 5.9e-8, 65504.0, -65504.0],
+                          np.float16)
+    rng = np.random.RandomState(3)
+    vals = np.concatenate([specials, rng.randn(1000).astype(np.float16)])
+    packed = np.zeros((len(vals), 16), np.uint8)  # zero nibbles -> vals*(-8) pattern
+    got = native.q40_to_i8(packed.reshape(len(vals), 1, 16),
+                           vals.reshape(len(vals), 1))
+    np.testing.assert_array_equal(got[1].reshape(-1), vals.astype(np.float32))
+
+
+def _toy_tokenizer() -> Tokenizer:
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)]
+    vocab += [b" ", b"he", b"ll", b"o", b"hell", b"hello", b" hello", b"\xc3\xa9"]
+    scores = [0.0] * 259 + [-1.0, -2.0, -2.5, -1.5, -3.0, -4.0, -5.0, -1.0]
+    return Tokenizer(TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2,
+                                   max_token_length=8))
+
+
+@pytest.mark.parametrize("text", ["hello", " hello world", "", "héllo",
+                                  "hello hello hello", "\x00\x01"])
+def test_native_bpe_matches_python(text):
+    t_native = _toy_tokenizer()
+    assert t_native._native_bpe() is not None
+
+    t_py = _toy_tokenizer()
+    t_py._native_tried = True  # force the pure-Python path
+
+    for bos, eos in ((True, False), (False, True), (True, True)):
+        assert t_native.encode(text, bos, eos) == t_py.encode(text, bos, eos), text
