@@ -33,12 +33,12 @@ import numpy as np
 
 sys.path.insert(0, "/root/repo")
 
-from distributed_llama_tpu.models.forward import init_kv_cache  # noqa: E402
 from distributed_llama_tpu.models.params import block_tensor_shapes  # noqa: E402
 from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType  # noqa: E402
 from distributed_llama_tpu.ops.rope import RopeTables  # noqa: E402
 from distributed_llama_tpu.parallel.mesh import make_mesh  # noqa: E402
-from distributed_llama_tpu.parallel.tp import make_sharded_forward, shard_params  # noqa: E402
+from distributed_llama_tpu.parallel.tp import (  # noqa: E402
+    init_sharded_kv_cache, make_sharded_forward, shard_params)
 from distributed_llama_tpu.quants import QK, FloatType, QTensor  # noqa: E402
 
 BASELINE_TOK_S = 1000.0 / 101.81  # Llama-2-7B, 1x GCP c3d VM (reference README.md:131)
@@ -106,7 +106,7 @@ def main():
     # here, so the host loop is the benchmark path.)
     step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
                                 donate_cache=True)
-    kc, vc = init_kv_cache(spec, dtype=dtype)
+    kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
 
     # NOTE: on the axon TPU tunnel, block_until_ready() returns before the device is
     # actually done; only a device->host transfer is an honest fence. Materialize a

@@ -1,3 +1,3 @@
 from .mesh import make_mesh  # noqa: F401
-from .sharding import kv_cache_pspec, param_pspecs  # noqa: F401
+from .sharding import effective_kv_heads, kv_cache_pspec, param_pspecs  # noqa: F401
 from .tp import make_sharded_forward, shard_params  # noqa: F401

@@ -70,13 +70,34 @@ def kv_cache_pspec_for_mesh(mesh) -> P:
     return kv_cache_pspec(AXIS_SP if mesh.shape.get(AXIS_SP, 1) > 1 else None)
 
 
+def effective_kv_heads(spec: ModelSpec, tp: int) -> int:
+    """KV-head count after TP replication.
+
+    The reference hard-fails when nSlices > nKvHeads (transformer.cpp:108-111), which
+    blocks 405B-class GQA models (8 KV heads) on pods with 16+ chips. Here the standard
+    GQA trick lifts the limit: when tp > n_kv_heads, each KV head is replicated across
+    tp/n_kv_heads adjacent shards (shard j holds KV head j*n_kv_heads//tp), so every
+    shard's query-head slice finds its KV head locally. wk/wv rows and the KV cache head
+    axis are expanded to `tp` heads at distribution time (parallel/tp.py shard_params).
+    """
+    if tp <= spec.n_kv_heads:
+        return spec.n_kv_heads
+    assert tp % spec.n_kv_heads == 0, (
+        f"tp={tp} must be a multiple of n_kv_heads={spec.n_kv_heads} to replicate "
+        "KV heads evenly")
+    return tp
+
+
 def check_divisibility(spec: ModelSpec, tp: int, sp: int = 1) -> None:
-    """The reference's hard constraint nSlices <= nKvHeads (transformer.cpp:108-111),
-    plus even-division checks that replace its 2^n assumption."""
-    assert spec.n_kv_heads % tp == 0, (
-        f"tp={tp} must divide n_kv_heads={spec.n_kv_heads} "
-        "(KV-head replication not yet enabled)")
-    assert spec.n_heads % tp == 0
+    """Even-division checks that replace the reference's 2^n assumption and its
+    nSlices <= nKvHeads limit (transformer.cpp:108-111; lifted via KV-head
+    replication, see effective_kv_heads)."""
+    hk = effective_kv_heads(spec, tp)  # asserts tp % n_kv_heads when replicating
+    assert hk % tp == 0, (
+        f"tp={tp} must divide n_kv_heads={spec.n_kv_heads} (or be a multiple of it "
+        "for KV-head replication)")
+    assert spec.n_heads % tp == 0, (
+        f"tp={tp} must divide n_heads={spec.n_heads}")
     assert spec.dim % tp == 0 and spec.hidden_dim % tp == 0
     assert spec.vocab_size % tp == 0
     if (spec.dim // tp) % 32 or (spec.hidden_dim // tp) % 32:

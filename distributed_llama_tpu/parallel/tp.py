@@ -21,7 +21,8 @@ from ..models.spec import ModelSpec
 from ..ops.rope import RopeTables
 from ..quants import QTensor
 from .mesh import AXIS_SP, AXIS_TP
-from .sharding import check_divisibility, kv_cache_pspec_for_mesh, param_pspecs
+from .sharding import (check_divisibility, effective_kv_heads, kv_cache_pspec_for_mesh,
+                       param_pspecs)
 
 
 def _expand_pspec_tree(params: dict[str, Any], pspecs: dict[str, Any]):
@@ -40,12 +41,49 @@ def _expand_pspec_tree(params: dict[str, Any], pspecs: dict[str, Any]):
     return out
 
 
+def _repeat_kv_rows(t: QTensor | Any, hk: int, rep: int) -> Any:
+    """Replicate each KV head's row block `rep` times along the row (out) axis.
+
+    Leaves are stacked (L, hk*hs, ...) arrays; rows stay whole-head-grouped so
+    P('tp') on the row axis lands KV head j*hk//tp on shard j — exactly the head
+    shard j's query slice attends with. Quant blocks run along the *in* axis, so
+    row replication never splits a block.
+    """
+    import numpy as np
+
+    def rep_leaf(a):
+        if a is None:
+            return None
+        rows = a.shape[1]
+        assert rows % hk == 0, (a.shape, hk)
+        hs_g = rows // hk
+        xp = np if isinstance(a, np.ndarray) else jax.numpy
+        grouped = a.reshape(a.shape[0], hk, hs_g, *a.shape[2:])
+        out = xp.repeat(grouped, rep, axis=1)
+        return out.reshape(a.shape[0], hk * rep * hs_g, *a.shape[2:])
+
+    if isinstance(t, QTensor):
+        return QTensor(t.ftype, rep_leaf(t.data), rep_leaf(t.scales), layout=t.layout)
+    return rep_leaf(t)
+
+
 def shard_params(params: dict[str, Any], mesh: Mesh,
                  spec: ModelSpec | None = None) -> dict[str, Any]:
     """Place params on the mesh per param_pspecs — the TPU-native 'loadRoot' weight
-    distribution (transformer.cpp:480-539) with device_put instead of socket writes."""
+    distribution (transformer.cpp:480-539) with device_put instead of socket writes.
+
+    When tp > n_kv_heads, wk/wv rows are replicated per KV head (effective_kv_heads)
+    before placement, lifting the reference's nSlices <= nKvHeads limit."""
+    tp = mesh.shape[AXIS_TP]
     if spec is not None:
-        check_divisibility(spec, mesh.shape[AXIS_TP])
+        check_divisibility(spec, tp)
+        hk_eff = effective_kv_heads(spec, tp)
+        if hk_eff != spec.n_kv_heads:
+            rep = hk_eff // spec.n_kv_heads
+            params = dict(params, blocks=dict(params["blocks"]))
+            for name in ("wk", "wv"):
+                params["blocks"][name] = _repeat_kv_rows(
+                    params["blocks"][name], spec.n_kv_heads, rep)
     pspec_tree = _expand_pspec_tree(params, param_pspecs(params))
 
     def put(leaf, spec):
@@ -53,6 +91,21 @@ def shard_params(params: dict[str, Any], mesh: Mesh,
 
     return jax.tree_util.tree_map(put, params, pspec_tree,
                                   is_leaf=lambda x: isinstance(x, P))
+
+
+def init_sharded_kv_cache(spec: ModelSpec, mesh: Mesh, batch: int = 1, dtype=None):
+    """Zeroed KV caches with the head axis already expanded for KV-head replication
+    and placed with the mesh's cache sharding. The one cache-construction path for
+    every sharded entry point — callers can't forget effective_kv_heads."""
+    import jax.numpy as jnp
+
+    from ..models.forward import init_kv_cache
+
+    dtype = dtype or jnp.float32
+    hk = effective_kv_heads(spec, mesh.shape[AXIS_TP])
+    kc, vc = init_kv_cache(spec, batch=batch, dtype=dtype, n_kv_heads=hk)
+    sh = NamedSharding(mesh, kv_cache_pspec_for_mesh(mesh))
+    return jax.device_put(kc, sh), jax.device_put(vc, sh)
 
 
 def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,

@@ -124,13 +124,10 @@ class Engine:
         return cls(spec, params, tokenizer, **kw)
 
     def _init_cache(self):
-        kc, vc = init_kv_cache(self.spec, batch=self.batch, dtype=self.dtype)
-        from jax.sharding import NamedSharding
+        from ..parallel.tp import init_sharded_kv_cache
 
-        from ..parallel.sharding import kv_cache_pspec_for_mesh
-
-        sh = NamedSharding(self.mesh, kv_cache_pspec_for_mesh(self.mesh))
-        return jax.device_put(kc, sh), jax.device_put(vc, sh)
+        return init_sharded_kv_cache(self.spec, self.mesh, batch=self.batch,
+                                     dtype=self.dtype)
 
     def reset(self) -> None:
         self.pos = 0

@@ -20,7 +20,8 @@ from distributed_llama_tpu.ops.ring_attention import (
 )
 from distributed_llama_tpu.ops.rope import RopeTables
 from distributed_llama_tpu.parallel.mesh import make_mesh
-from distributed_llama_tpu.parallel.tp import make_sharded_forward, shard_params
+from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache, make_sharded_forward,
+                                                shard_params)
 from distributed_llama_tpu.quants import FloatType
 from distributed_llama_tpu.runtime.engine import Engine
 from distributed_llama_tpu.runtime.sampler import Sampler
@@ -102,7 +103,7 @@ def test_forward_sp_tp_equals_unsharded():
     mesh = make_mesh(sp=2, tp=2)
     sparams = shard_params(params, mesh, spec)
     step = make_sharded_forward(spec, mesh, sparams, donate_cache=False)
-    kc, vc = init_kv_cache(spec)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
     got, gkc, gvc = step(sparams, rope, tokens, kc, vc, jnp.int32(0))
     got2, _, _ = step(sparams, rope, jnp.asarray([[3]]), gkc, gvc, jnp.int32(8))
 

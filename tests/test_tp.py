@@ -13,7 +13,9 @@ from distributed_llama_tpu.models.forward import forward, init_kv_cache
 from distributed_llama_tpu.models.params import init_random_params
 from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec, RopeType
 from distributed_llama_tpu.ops.rope import RopeTables
-from distributed_llama_tpu.parallel import make_mesh, make_sharded_forward, shard_params
+from distributed_llama_tpu.parallel import (make_mesh, make_sharded_forward,
+                                            shard_params)
+from distributed_llama_tpu.parallel.tp import init_sharded_kv_cache
 from distributed_llama_tpu.quants import FloatType
 
 
@@ -41,7 +43,7 @@ def tp_logits(spec, params, tokens, tp, **fwd_kw):
     mesh = make_mesh(tp=tp)
     rope = RopeTables.create(spec)
     sp = shard_params(params, mesh, spec)
-    kc, vc = init_kv_cache(spec)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
     step = make_sharded_forward(spec, mesh, sp, donate_cache=False, **fwd_kw)
     logits, kc2, vc2 = step(sp, rope, tokens, kc, vc, jnp.int32(0))
     return np.asarray(logits), kc2
@@ -77,11 +79,46 @@ def test_gqa_tp_up_to_kv_heads():
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
 
 
-def test_tp_exceeding_kv_heads_raises():
-    spec = tp_spec(n_heads=8, n_kv_heads=4)
+@pytest.mark.parametrize("tp,hk", [(8, 4), (8, 2), (4, 1)])
+def test_gqa_tp_beyond_kv_heads(tp, hk):
+    """tp > n_kv_heads via KV-head replication — the reference's hard limit
+    (transformer.cpp:108-111) lifted; gates 405B (8 KV heads) on 16+ chips."""
+    spec = tp_spec(n_heads=8, n_kv_heads=hk)
+    params = init_random_params(spec, FloatType.Q40, seed=29)
+    tokens = jnp.asarray([[3, 1, 4, 1, 5]])
+    want = reference_logits(spec, params, tokens)
+    got, kc2 = tp_logits(spec, params, tokens, tp)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-3)
+    # cache head axis expanded to tp heads, one per shard
+    assert kc2.shape[2] == tp
+    assert kc2.sharding.shard_shape(kc2.shape)[2] == 1
+
+
+def test_kv_replication_with_sequence_parallelism():
+    """tp > n_kv_heads on an sp x tp mesh (the pod-scale shape: 405B runs sp x tp)."""
+    spec = tp_spec(n_heads=8, n_kv_heads=2)
+    params = init_random_params(spec, FloatType.Q40, seed=31)
+    tokens = jnp.asarray([[1, 7, 23, 5]])
+    want = reference_logits(spec, params, tokens)
+
+    mesh = make_mesh(sp=2, tp=4)
+    rope = RopeTables.create(spec)
+    sharded = shard_params(params, mesh, spec)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    step = make_sharded_forward(spec, mesh, sharded, donate_cache=False)
+    got, _, _ = step(sharded, rope, tokens, kc, vc, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-3)
+
+
+def test_tp_not_multiple_of_kv_heads_raises():
+    """Replication needs tp % n_kv_heads == 0; ragged splits stay an error.
+
+    n_heads=24 keeps n_heads % tp == 0 satisfied, so the failure can only come from
+    the tp=8 vs n_kv_heads=3 mismatch — isolating the replication guard."""
+    spec = tp_spec(n_heads=24, n_kv_heads=3, dim=768, hidden_dim=768)
     params = init_random_params(spec, FloatType.F32, seed=17)
     tokens = jnp.asarray([[3]])
-    with pytest.raises(AssertionError):
+    with pytest.raises(AssertionError, match="n_kv_heads"):
         tp_logits(spec, params, tokens, 8)
 
 
