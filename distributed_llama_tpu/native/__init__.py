@@ -10,7 +10,9 @@ tokenizer.cpp) is C++ while we keep the accelerator math in XLA/Pallas.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
 import threading
 
@@ -18,7 +20,27 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "dlt_native.cpp")
-_SO = os.path.join(_DIR, "_build", "dlt_native.so")
+
+
+def _host_tag() -> str:
+    """ISA fingerprint for the build cache: the .so is compiled -march=native, so a
+    checkout shared across heterogeneous hosts (NFS, reused container image) must not
+    load another machine's binary — that SIGILLs at call time, past the build/dlopen
+    try/except."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    h = hashlib.sha256(f"{platform.machine()}:{flags}".encode()).hexdigest()[:12]
+    return f"{platform.machine()}-{h}"
+
+
+_SO = os.path.join(_DIR, "_build", f"dlt_native-{_host_tag()}.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None | bool = None  # None = not tried, False = unavailable
@@ -57,6 +79,8 @@ def _load() -> ctypes.CDLL | bool:
     lib.dlt_q80_deinterleave.argtypes = [u8p, i64, i8p, u16p]
     lib.dlt_q40_to_i8.argtypes = [u8p, u16p, i64, i8p, f32p]
     lib.dlt_f16_to_f32.argtypes = [u16p, i64, f32p]
+    lib.dlt_xorshift_f32_fill.restype = ctypes.c_uint64
+    lib.dlt_xorshift_f32_fill.argtypes = [ctypes.c_uint64, i64, ctypes.c_double, f32p]
     lib.dlt_bpe_create.restype = ctypes.c_void_p
     lib.dlt_bpe_create.argtypes = [u8p, ctypes.POINTER(i64), f32p, i64]
     lib.dlt_bpe_destroy.argtypes = [ctypes.c_void_p]
@@ -123,6 +147,22 @@ def q40_to_i8(packed: np.ndarray, scales: np.ndarray
     lead = packed.shape[:-2]
     nbl = packed.shape[-2]
     return vals.reshape(*lead, nbl * 32), sc.reshape(*lead, nbl)
+
+
+def xorshift_f32_fill(state: int, n: int, div: float = 1.0
+                      ) -> tuple[np.ndarray, int] | None:
+    """n draws of the reference's xorshift* randomF32 stream, each divided by `div`
+    in double precision (bit-exact with `randomF32(&state) / div`). Returns
+    (values f32 (n,), final state); None when the native library is unavailable
+    (the stream is sequential — a Python fallback would be minutes for the
+    200M-float golden-test weight streams, so callers skip instead)."""
+    lib = _get()
+    if lib is None:
+        return None
+    out = np.empty(n, np.float32)
+    end = lib.dlt_xorshift_f32_fill(ctypes.c_uint64(state), n, div,
+                                    _ptr(out, ctypes.c_float))
+    return out, int(end)
 
 
 class NativeBPE:

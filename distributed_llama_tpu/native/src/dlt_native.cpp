@@ -124,6 +124,23 @@ void dlt_f16_to_f32(const uint16_t* in, int64_t n, float* out) {
     });
 }
 
+// xorshift* f32 stream, bit-exact with the reference's randomU32/randomF32
+// (src/utils.cpp:79-90) including the double-precision divide its golden tests
+// apply to each draw (e.g. `randomF32(&state) / 120.0`, llama2-tasks-test.cpp:561).
+// Sequential by construction (each draw feeds the next state), hence native.
+// Returns the final state so callers can continue the stream.
+uint64_t dlt_xorshift_f32_fill(uint64_t state, int64_t n, double div, float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        state ^= state >> 12;
+        state ^= state << 25;
+        state ^= state >> 27;
+        uint32_t u = (uint32_t)((state * 0x2545F4914F6CDD1Dull) >> 32);
+        float f = (float)(u >> 8) / 16777216.0f;  // randomF32: <0,1)
+        out[i] = (float)((double)f / div);
+    }
+    return state;
+}
+
 // ---------------------------------------------------------------------------
 // BPE encoder (behavior-parity with tokenizer/bpe.py <- src/tokenizer.cpp:170-292)
 // ---------------------------------------------------------------------------

@@ -24,7 +24,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.forward import forward, init_kv_cache
 from ..models.params import Params, prepare_for_pallas
 from ..models.spec import ModelSpec
 from ..ops.rope import RopeTables
