@@ -98,6 +98,13 @@ def mode_inference(args) -> None:
     tok = engine.tokenizer
     prompt = tok.encode(args.prompt or "Hello world", add_bos=True)
     pieces: list[bytes] = []
+    if engine.tp > 1 or engine.sp > 1:
+        # account the compiled step's actual collectives so the S/R columns are
+        # measured (the reference counted socket bytes; dllama.cpp:76-93)
+        mt = engine.collective_stats()
+        counts = " ".join(f"{k}x{v}" for k, v in sorted(mt.counts.items()))
+        print(f"🔷 Collectives/step: {counts} "
+              f"({mt.total_payload_bytes / 1024:.0f} kB payload)")
 
     def on_token(t):
         piece = tok.decode_piece(prompt[-1] if not pieces else 0, t)
@@ -111,6 +118,7 @@ def mode_inference(args) -> None:
     for i, (g, inf) in enumerate(zip(stats.token_ms, stats.infer_ms)):
         print(f"🔶 G {g:7.2f} ms I {inf:7.2f} ms T {g - inf:7.2f} ms "
               f"S {stats.sent_kbytes_per_token:8.0f} kB R {stats.recv_kbytes_per_token:8.0f} kB {pieces[i].decode('utf-8', 'replace')}")
+    print(f"S/R source:          {stats.traffic_source} per-device ring bytes")
     print(f"Generated tokens:    {stats.generated_tokens}")
     print(f"Avg tokens / second: {stats.tokens_per_second:.2f}")
     print(f"Avg generation time: {stats.avg_token_ms:.2f} ms")

@@ -1,0 +1,225 @@
+"""Measured collective traffic: exact per-execution accounting of the step program.
+
+The reference counts real socket bytes per token (src/socket.cpp:280-285) and prints
+them as the S/R columns (dllama.cpp:76-93). A TPU program's transfers are the
+collective ops in the compiled step, so the honest equivalent is to account each
+collective the program executes — not an analytic formula that assumes which ops
+exist (runtime/engine.py keeps that formula, explicitly labeled "modeled", for when
+no compiled step is available).
+
+Two accounting paths:
+
+- `jaxpr_collective_traffic` — walks the traced step jaxpr, recursing into scan /
+  while / cond / pjit / shard_map and multiplying by scan trip counts, so a psum
+  inside the layer scan is counted n_layers times per execution. This is the primary
+  path: exact bytes per dispatch, including loop bodies that appear only once in the
+  HLO module text.
+- `collective_traffic` — parses an HLO module text per instruction (XLA's chosen
+  async/combined forms). Static module view: loop bodies count once.
+
+Per-device wire-byte accounting uses the standard ring-algorithm costs:
+
+    all-reduce        payload P          sends 2 (n-1)/n * P
+    all-gather        output P           sends (n-1)/n * P   (each shard passed n-1 hops)
+    reduce-scatter    output P           sends (n-1) * P     (input = n * P)
+    all-to-all        payload P          sends (n-1)/n * P
+    collective-permute payload P         sends P
+
+where n is the group size (replica_groups in HLO; mesh axis sizes in the jaxpr).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+# async collectives appear as <op>-start / <op>-done pairs; count only the -start
+# (or the bare sync op) so each transfer is accounted once
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute")
+
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(shape_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def _group_size(line: str, default: int) -> int:
+    m = _GROUPS_RE.search(line)
+    if m:
+        return len(m.group(1).split(","))
+    m = _GROUPS_IOTA_RE.search(line)  # iota format: replica_groups=[ngroups,size]<=[n]
+    if m:
+        return int(m.group(2))
+    return default
+
+
+def _sent_factor(op: str, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    if op == "all-reduce":
+        return 2.0 * (n - 1) / n
+    if op == "all-gather":
+        return (n - 1) / n
+    if op == "reduce-scatter":
+        return float(n - 1)
+    if op == "all-to-all":
+        return (n - 1) / n
+    return 1.0  # collective-permute
+
+
+@dataclass
+class CollectiveTraffic:
+    """Per-dispatch collective accounting (one compiled program execution)."""
+
+    counts: dict[str, int] = field(default_factory=dict)
+    payload_bytes: dict[str, int] = field(default_factory=dict)
+    sent_bytes_per_device: float = 0.0  # == received, for the ring algorithms above
+
+    @property
+    def total_payload_bytes(self) -> int:
+        return sum(self.payload_bytes.values())
+
+
+_JAXPR_COLLECTIVES = {
+    "psum": "all-reduce",
+    "all_gather": "all-gather",
+    "reduce_scatter": "reduce-scatter",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+}
+
+
+def _axes_size(params: dict, axis_sizes: dict[str, int]) -> int:
+    axes = params.get("axes") or params.get("axis_name") or ()
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    n = 1
+    for a in axes:
+        n *= axis_sizes.get(a, 1) if isinstance(a, str) else 1
+    return n
+
+
+def _merge(dst: CollectiveTraffic, src: CollectiveTraffic, mult: int) -> None:
+    for op, c in src.counts.items():
+        dst.counts[op] = dst.counts.get(op, 0) + c * mult
+    for op, b in src.payload_bytes.items():
+        dst.payload_bytes[op] = dst.payload_bytes.get(op, 0) + b * mult
+    dst.sent_bytes_per_device += src.sent_bytes_per_device * mult
+
+
+def _walk_jaxpr(jaxpr, axis_sizes: dict[str, int], mult: int,
+                out: CollectiveTraffic) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _JAXPR_COLLECTIVES:
+            op = _JAXPR_COLLECTIVES[name]
+            payload = sum(v.aval.size * v.aval.dtype.itemsize for v in eqn.outvars)
+            n = _axes_size(eqn.params, axis_sizes)
+            out.counts[op] = out.counts.get(op, 0) + mult
+            out.payload_bytes[op] = out.payload_bytes.get(op, 0) + payload * mult
+            out.sent_bytes_per_device += _sent_factor(op, n) * payload * mult
+            continue
+        if name == "cond":
+            # only one branch executes per dispatch: account the heaviest branch
+            # rather than summing both (which would overstate traffic)
+            branch_traffic = []
+            for pval in eqn.params.values():
+                for sub in _sub_jaxprs(pval):
+                    t = CollectiveTraffic()
+                    _walk_jaxpr(sub, axis_sizes, 1, t)
+                    branch_traffic.append(t)
+            if branch_traffic:
+                worst = max(branch_traffic, key=lambda t: t.sent_bytes_per_device)
+                _merge(out, worst, mult)
+            continue
+        # recurse into sub-jaxprs, multiplying by loop trip counts
+        sub_mult = mult
+        if name == "scan":
+            sub_mult = mult * int(eqn.params.get("length", 1))
+        for pval in eqn.params.values():
+            for sub in _sub_jaxprs(pval):
+                _walk_jaxpr(sub, axis_sizes, sub_mult, out)
+
+
+def _sub_jaxprs(pval: Any):
+    import jax.extend.core as jex_core
+
+    if isinstance(pval, jex_core.ClosedJaxpr):
+        yield pval.jaxpr
+    elif isinstance(pval, jex_core.Jaxpr):
+        yield pval
+    elif isinstance(pval, (tuple, list)):
+        for item in pval:
+            yield from _sub_jaxprs(item)
+
+
+def jaxpr_collective_traffic(closed_jaxpr, axis_sizes: dict[str, int]
+                             ) -> CollectiveTraffic:
+    """Exact per-execution collective accounting of a traced step program.
+
+    `axis_sizes` maps mesh axis names to sizes (mesh.shape). Counts reflect one
+    execution of the program: collectives inside lax.scan bodies are multiplied by
+    the scan length; lax.cond contributes its heaviest branch (only one runs);
+    while-loop bodies, whose trip counts are data-dependent, are counted once per
+    entry."""
+    out = CollectiveTraffic()
+    _walk_jaxpr(closed_jaxpr.jaxpr, dict(axis_sizes), 1, out)
+    return out
+
+
+def collective_traffic(hlo_text: str, default_group_size: int) -> CollectiveTraffic:
+    """Account every collective instruction in an (optimized) HLO module text.
+
+    `default_group_size` is used when an instruction carries no parseable
+    replica_groups (e.g. empty groups meaning "all devices").
+    """
+    out = CollectiveTraffic()
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        # instruction form: %name = SHAPE opcode(...), ...
+        m = re.match(r"%?[\w.\-]+ = (.+?) ([a-z0-9\-]+)\(", line)
+        if not m:
+            continue
+        shape_str, op = m.group(1), m.group(2)
+        if op.endswith("-done"):
+            continue  # async completion: transfer already counted at its -start
+        is_start = op.endswith("-start")
+        if is_start:
+            op = op[: -len("-start")]
+        if op not in _COLLECTIVES:
+            continue
+        if is_start and "(" in shape_str:
+            # async-start outputs are (operand, result, ...) tuples; the result
+            # (last element) is the transferred payload
+            dt, dims = _SHAPE_RE.findall(shape_str)[-1]
+            payload = _DTYPE_BYTES.get(dt, 0)
+            for d in dims.split(","):
+                if d:
+                    payload *= int(d)
+        else:
+            payload = _shape_bytes(shape_str)
+        n = _group_size(line, default_group_size)
+        out.counts[op] = out.counts.get(op, 0) + 1
+        out.payload_bytes[op] = out.payload_bytes.get(op, 0) + payload
+        out.sent_bytes_per_device += _sent_factor(op, n) * payload
+    return out

@@ -15,5 +15,23 @@ import os
 def apply_platform_env() -> None:
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    # If a backend was already initialized (something called jax.devices() before us),
+    # the config update cannot take effect — warn loudly instead of silently running
+    # on the wrong platform (e.g. a CPU-mesh dry run landing on the TPU).
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:
+        initialized = False
+    if initialized and jax.default_backend() not in want.split(","):
+        import sys
+
+        print(f"warning: JAX_PLATFORMS={want} requested but the "
+              f"'{jax.default_backend()}' backend is already initialized; "
+              "the platform cannot change now", file=sys.stderr)
+        return
+    jax.config.update("jax_platforms", want)

@@ -45,8 +45,13 @@ class GenerationStats:
     prefill_ms: float = 0.0
     token_ms: list[float] = field(default_factory=list)
     infer_ms: list[float] = field(default_factory=list)
-    sent_kbytes_per_token: float = 0.0  # analytic ICI traffic model
+    sent_kbytes_per_token: float = 0.0
     recv_kbytes_per_token: float = 0.0
+    # provenance of the S/R numbers: "modeled" = the analytic formula below;
+    # "measured" = exact per-step accounting of the compiled program's collectives
+    # (Engine.collective_stats). The reference measured socket bytes at runtime
+    # (socket.cpp:280-285); printing a model as if measured was a round-1 defect.
+    traffic_source: str = "modeled"
 
     @property
     def avg_token_ms(self) -> float:
@@ -107,7 +112,8 @@ class Engine:
             compress_collectives=compress_collectives, donate_cache=True)
         self.k_cache, self.v_cache = self._init_cache()
         self.pos = 0
-        self._decode_loops: dict[int, object] = {}  # chunk size -> compiled device loop
+        self._decode_loops: dict[tuple[int, str], object] = {}  # (chunk, mode) -> loop
+        self._measured_traffic = None  # lazy CollectiveTraffic of the T=1 decode step
 
     @classmethod
     def load(cls, model_path: str, tokenizer_path: str | None = None, *,
@@ -130,6 +136,39 @@ class Engine:
 
     def reset(self) -> None:
         self.pos = 0
+
+    def collective_stats(self):
+        """Exact per-decode-step collective traffic of the compiled step program.
+
+        Traces the T=1 decode step and accounts every collective it executes
+        (scan-body psums x n_layers, logits all-gather, ...) with ring-algorithm
+        wire costs — the measured replacement for collective_kbytes_per_token's
+        analytic model (reference counted socket bytes, socket.cpp:280-285)."""
+        if self._measured_traffic is None:
+            from ..parallel.hlo_stats import jaxpr_collective_traffic
+
+            tokens = jnp.zeros((self.batch, 1), jnp.int32)
+            closed = jax.make_jaxpr(self._step)(
+                self.params, self.rope, tokens, self.k_cache, self.v_cache,
+                jnp.int32(0))
+            self._measured_traffic = jaxpr_collective_traffic(
+                closed, dict(self.mesh.shape))
+        return self._measured_traffic
+
+    def _fill_traffic(self, stats: GenerationStats, measured=None,
+                      per_tokens: int = 1) -> None:
+        """Per-token S/R from `measured` (a CollectiveTraffic for a program covering
+        `per_tokens` tokens) or, when None, the analytic model — provenance recorded
+        either way. Each program (host step vs device loop) must be measured by its
+        own trace; a different program's numbers are never presented as measured."""
+        if measured is not None:
+            kb = measured.sent_bytes_per_device / per_tokens / 1024.0
+            stats.sent_kbytes_per_token = stats.recv_kbytes_per_token = kb
+            stats.traffic_source = "measured"
+        else:
+            stats.sent_kbytes_per_token = stats.recv_kbytes_per_token = (
+                collective_kbytes_per_token(self.spec, self.tp, self.compress))
+            stats.traffic_source = "modeled"
 
     # ------------------------------------------------------------------
     # core stepping
@@ -171,8 +210,7 @@ class Engine:
         """Host generation loop: prefill + sample/step until max_tokens, context end, or
         stop_check truth. on_token(token_id) streams tokens out."""
         stats = GenerationStats()
-        stats.sent_kbytes_per_token = stats.recv_kbytes_per_token = (
-            collective_kbytes_per_token(self.spec, self.tp, self.compress))
+        self._fill_traffic(stats, self._measured_traffic)
         logits = self.prefill(prompt_tokens, stats)
         out: list[int] = []
         for _ in range(max_tokens):
@@ -219,6 +257,23 @@ class Engine:
                 compress_collectives=self.compress, donate_cache=True)
         return self._decode_loops[chunk, mode]
 
+    def _loop_traffic(self, chunk: int, mode: str, loop):
+        """Measured collective traffic of the device-loop program itself (it is a
+        different compiled program than the host step — its own trace, not the
+        T=1 step's, covers `chunk` tokens). Computed only when the user opted into
+        measurement via collective_stats() — tracing a large model costs seconds."""
+        key = ("loop", chunk, mode)
+        if key not in self._decode_loops:
+            from ..parallel.hlo_stats import jaxpr_collective_traffic
+
+            closed = jax.make_jaxpr(loop)(
+                self.params, self.rope, jnp.int32(1), self.k_cache, self.v_cache,
+                jnp.int32(0), jax.random.PRNGKey(0), jnp.float32(0.0),
+                jnp.float32(0.9))
+            self._decode_loops[key] = jaxpr_collective_traffic(
+                closed, dict(self.mesh.shape))
+        return self._decode_loops[key]
+
     def generate_chunked(self, prompt_tokens: list[int], max_tokens: int, sampler,
                          on_token=None, stop_check=None, chunk: int = 16,
                          ) -> tuple[list[int], GenerationStats]:
@@ -231,8 +286,7 @@ class Engine:
         positions, so mid-chunk stops need no rollback.
         """
         stats = GenerationStats()
-        stats.sent_kbytes_per_token = stats.recv_kbytes_per_token = (
-            collective_kbytes_per_token(self.spec, self.tp, self.compress))
+        self._fill_traffic(stats)
         if len(prompt_tokens) > 1:
             self.prefill(prompt_tokens[:-1], stats)
         stats.prompt_tokens = len(prompt_tokens)
@@ -264,13 +318,19 @@ class Engine:
             # just truncates the emitted tokens — cache entries past pos are dead and
             # overwritten by later writes at those positions
             loop = self._decode_loop(chunk, mode)
+            if self._measured_traffic is not None and stats.traffic_source != "measured":
+                self._fill_traffic(stats, self._loop_traffic(chunk, mode, loop),
+                                   per_tokens=chunk)
             t0 = time.perf_counter()
             key, sub = jax.random.split(key)
             tokens, _, self.k_cache, self.v_cache = loop(
                 self.params, self.rope, token, self.k_cache, self.v_cache, self.pos,
                 sub, temperature, topp)
             tokens = np.asarray(tokens)[:want]
-            dt_ms = (time.perf_counter() - t0) * 1000.0 / len(tokens)
+            # the dispatch always computes a full `chunk` of tokens even when the
+            # emitted tail is shorter — divide by the compiled chunk size so
+            # per-token stats reflect actual device cost
+            dt_ms = (time.perf_counter() - t0) * 1000.0 / chunk
             for i, t in enumerate(tokens.tolist()):
                 out.append(t)
                 stats.generated_tokens += 1

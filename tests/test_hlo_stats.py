@@ -1,0 +1,152 @@
+"""Measured collective-traffic accounting (parallel/hlo_stats.py).
+
+Replaces round 1's print-the-model-as-if-measured defect: the S/R columns now come
+from exact accounting of the compiled step program's collectives (the reference
+measured socket bytes per token, src/socket.cpp:280-285)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.parallel.hlo_stats import (CollectiveTraffic,
+                                                      collective_traffic,
+                                                      jaxpr_collective_traffic)
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.runtime.engine import Engine
+
+
+def test_hlo_text_parser():
+    hlo = """
+  HloModule jit_step
+  %x.1 = f32[4,256]{1,0} parameter(0)
+  %all-reduce.1 = f32[256]{0} all-reduce(f32[256]{0} %y), replica_groups={{0,1,2,3}}, to_apply=%add
+  %ag = (f32[64]{0}, f32[256]{0}) all-gather-start(f32[64]{0} %z), replica_groups={{0,1,2,3}}
+  %ag2 = f32[256]{0} all-gather-done((f32[64]{0}, f32[256]{0}) %ag)
+  %cp = s8[128]{0} collective-permute(s8[128]{0} %w), source_target_pairs={{0,1}}
+"""
+    t = collective_traffic(hlo, default_group_size=4)
+    assert t.counts == {"all-reduce": 1, "all-gather": 1, "collective-permute": 1}
+    assert t.payload_bytes["all-reduce"] == 256 * 4
+    assert t.payload_bytes["all-gather"] == 256 * 4  # result element of the tuple
+    assert t.payload_bytes["collective-permute"] == 128
+    want = 2 * 3 / 4 * 1024 + 3 / 4 * 1024 + 128
+    assert abs(t.sent_bytes_per_device - want) < 1e-6
+
+
+def test_jaxpr_walker_counts_scan_iterations():
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tp=4)
+
+    def f(x):
+        def body(c, _):
+            return c + jax.lax.psum(x, "tp"), None
+
+        out, _ = jax.lax.scan(body, jnp.zeros_like(x), None, length=3)
+        return jax.lax.all_gather(out, "tp", tiled=True)
+
+    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("tp"),), out_specs=P(),
+                       check_vma=False)
+    closed = jax.make_jaxpr(sm)(jnp.ones((8,), jnp.float32))
+    t = jaxpr_collective_traffic(closed, dict(mesh.shape))
+    assert t.counts["all-reduce"] == 3  # psum inside the scan body, length 3
+    assert t.counts["all-gather"] == 1
+    # per-shard psum payload: (2,) f32 = 8 B x 3 iterations
+    assert t.payload_bytes["all-reduce"] == 3 * 2 * 4
+    assert t.payload_bytes["all-gather"] == 8 * 4
+
+
+@pytest.fixture(scope="module")
+def tp4_engine():
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=256, hidden_dim=256, n_layers=2,
+                     n_heads=8, n_kv_heads=8, vocab_size=256, seq_len=16,
+                     rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=7)
+    return Engine(spec, params, tp=4)
+
+
+def test_engine_measured_traffic(tp4_engine):
+    eng = tp4_engine
+    t = eng.collective_stats()
+    spec = eng.spec
+    # the step's semantic collectives: 2 psums per layer (attention-out, ffn-out)
+    # + the logits all-gather
+    assert t.counts["all-reduce"] == 2 * spec.n_layers
+    assert t.counts["all-gather"] == 1
+    assert t.payload_bytes["all-reduce"] == 2 * spec.n_layers * spec.dim * 4
+    assert t.payload_bytes["all-gather"] == spec.vocab_size * 4
+    want_sent = (2 * 3 / 4 * t.payload_bytes["all-reduce"]
+                 + 3 / 4 * t.payload_bytes["all-gather"])
+    assert abs(t.sent_bytes_per_device - want_sent) < 1e-6
+
+
+def test_generate_stats_use_measured_traffic(tp4_engine):
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    eng = tp4_engine
+    eng.reset()
+    eng.collective_stats()  # computed -> generate() stats switch to measured
+    _, stats = eng.generate([1, 2], 3, Sampler(eng.spec.vocab_size, temperature=0.0))
+    assert stats.traffic_source == "measured"
+    assert stats.sent_kbytes_per_token == pytest.approx(
+        eng.collective_stats().sent_bytes_per_device / 1024.0)
+
+
+def test_cond_counts_heaviest_branch_only():
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(tp=4)
+
+    def f(x, flag):
+        return jax.lax.cond(
+            flag,
+            lambda x: jax.lax.psum(x, "tp"),                   # 8 B payload
+            lambda x: jax.lax.psum(x[:1], "tp").repeat(2),     # 4 B payload
+            x)
+
+    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("tp"), P()), out_specs=P("tp"),
+                       check_vma=False)
+    closed = jax.make_jaxpr(sm)(jnp.ones((8,), jnp.float32), jnp.bool_(True))
+    t = jaxpr_collective_traffic(closed, dict(mesh.shape))
+    # one branch executes: the heavier (8 B) psum is counted once, not both summed
+    assert t.counts["all-reduce"] == 1
+    assert t.payload_bytes["all-reduce"] == 2 * 4
+
+
+def test_device_loop_stats_measure_loop_program(tp4_engine):
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    eng = tp4_engine
+    eng.reset()
+    eng.collective_stats()  # opt into measurement
+    _, stats = eng.generate_chunked([1, 2], 4,
+                                    Sampler(eng.spec.vocab_size, temperature=0.0),
+                                    chunk=4)
+    assert stats.traffic_source == "measured"
+    lt = eng._decode_loops[("loop", 4, "greedy")]
+    assert stats.sent_kbytes_per_token == pytest.approx(
+        lt.sent_bytes_per_device / 4 / 1024.0)
+    # per-token bytes of the loop program match the per-token host step
+    assert stats.sent_kbytes_per_token == pytest.approx(
+        eng.collective_stats().sent_bytes_per_device / 1024.0, rel=0.01)
+
+
+def test_modeled_traffic_labeled():
+    """Without a collective_stats() call the analytic model is used and says so."""
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=64, n_layers=1,
+                     n_heads=2, n_kv_heads=2, vocab_size=64, seq_len=8,
+                     rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=9)
+    eng = Engine(spec, params, tp=2)
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    _, stats = eng.generate([1], 2, Sampler(spec.vocab_size, temperature=0.0))
+    assert stats.traffic_source == "modeled"
+    assert stats.sent_kbytes_per_token > 0
