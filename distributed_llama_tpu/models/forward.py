@@ -177,14 +177,21 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             use_pallas: bool = False, compress_collectives: bool = False):
     """Run T tokens through the model against the KV cache.
 
-    tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar.
-    Returns (logits (B, T, vocab) f32, new_k_cache, new_v_cache).
+    tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar
+    (all rows at one offset — the reference's single `pos`) or (B,) per-row offsets
+    (continuous batching: each sequence decodes at its own position; the reference's
+    single-slot pos has no analog). Returns (logits (B, T, vocab) f32, caches).
 
     Equivalent of Inference::infer (tasks.cpp:173-184) for the whole token chunk; the
     embedding-row copy at tasks.cpp:176-177 is the take() below, the task loop is the scan.
     """
     t = tokens.shape[1]
-    positions = start_pos + jnp.arange(t, dtype=jnp.int32)
+    start_pos = jnp.asarray(start_pos)
+    if start_pos.ndim == 1:
+        assert sp_size == 1, "per-row start_pos is not supported with sp (ring) sharding"
+        positions = start_pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # (B, T)
+    else:
+        positions = start_pos + jnp.arange(t, dtype=jnp.int32)
     x = jnp.take(params["embedding"], tokens, axis=0).astype(dtype)
     if spec.arch_type == ArchType.GROK1:
         x = x * GROK_EMBEDDING_SCALE

@@ -24,8 +24,11 @@ from .kernels import masked_softmax
 
 def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                   positions: jax.Array) -> jax.Array:
-    """Causal GQA attention of T query tokens (at absolute `positions`, shape (T,))
-    against the full cache. Returns (B, T, n_q_heads * hs)."""
+    """Causal GQA attention of T query tokens against the full cache.
+
+    positions: absolute query positions, (T,) shared across the batch or (B, T)
+    per-row (continuous batching: each batch row decodes at its own offset).
+    Returns (B, T, n_q_heads * hs)."""
     b, t, hq, hs = q.shape
     _, hk, s, _ = k_cache.shape
     g = hq // hk
@@ -34,8 +37,13 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     # (B, hk, g, T, S)
     scores = jnp.einsum("btkgd,bksd->bkgts", qg.astype(jnp.float32),
                         k_cache.astype(jnp.float32)) * scale
-    valid = jnp.arange(s)[None, :] <= positions[:, None]  # (T, S) causal mask
-    probs = masked_softmax(scores, valid[None, None, None, :, :])
+    if positions.ndim == 1:
+        valid = jnp.arange(s)[None, :] <= positions[:, None]  # (T, S) causal mask
+        mask = valid[None, None, None, :, :]
+    else:
+        valid = jnp.arange(s)[None, None, :] <= positions[:, :, None]  # (B, T, S)
+        mask = valid[:, None, None, :, :]
+    probs = masked_softmax(scores, mask)
     out = jnp.einsum("bkgts,bksd->btkgd", probs, v_cache.astype(jnp.float32))
     return out.reshape(b, t, hq * hs).astype(q.dtype)
 
@@ -45,10 +53,16 @@ def update_kv_cache(k_cache: jax.Array, v_cache: jax.Array, k_new: jax.Array,
     """Write T new kv vectors at [start_pos, start_pos+T) into head-major caches.
 
     k_new/v_new: (B, T, n_kv_heads, hs) -> caches (B, n_kv_heads, S, hs).
-    Replaces the reference's direct in-cache matmul write (llama2-tasks.cpp:38-44).
+    start_pos: scalar (all rows write at the same offset) or (B,) per-row offsets
+    (continuous batching). Replaces the reference's direct in-cache matmul write
+    (llama2-tasks.cpp:38-44).
     """
     k_t = jnp.swapaxes(k_new, 1, 2).astype(k_cache.dtype)  # (B, hk, T, hs)
     v_t = jnp.swapaxes(v_new, 1, 2).astype(v_cache.dtype)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (0, 0, start_pos, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, start_pos, 0))
-    return k_cache, v_cache
+    if start_pos.ndim == 0:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k_t, (0, 0, start_pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v_t, (0, 0, start_pos, 0))
+        return k_cache, v_cache
+    row_write = jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0)))
+    return row_write(k_cache, k_t, start_pos), row_write(v_cache, v_t, start_pos)

@@ -59,8 +59,11 @@ def param_pspecs(params: dict[str, Any]) -> dict[str, Any]:
 
 
 def kv_cache_pspec(seq_axis: str | None = None) -> P:
-    """Cache (L, B, hk, S, hs): heads on tp (KvCacheSlice), optionally S on sp."""
-    return P(None, None, AXIS_TP, seq_axis)
+    """Cache (L, B, hk, S, hs): batch on dp, heads on tp (KvCacheSlice),
+    optionally S on sp. dp/sp of size 1 make those entries no-ops."""
+    from .mesh import AXIS_DP
+
+    return P(None, AXIS_DP, AXIS_TP, seq_axis)
 
 
 def kv_cache_pspec_for_mesh(mesh) -> P:
