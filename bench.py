@@ -1,18 +1,23 @@
 #!/usr/bin/env python
 """Benchmark: Llama-2-7B-shaped Q40 single-chip decode throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}. Extra fields:
+    weight_gb      — HBM bytes decode must stream per token (weights + scales)
+    achieved_gbps  — weight_gb / measured step time (lower bound on attained bandwidth)
+    ms_per_token   — mean decode step wall time (over --steps dispatches)
 
 Baseline: the reference's best published single-node Llama-2-7B number — 101.81 ms/token
 (9.82 tok/s) on a GCP c3d-highcpu-30 VM (reference README.md:129-131, BASELINE.md).
 vs_baseline > 1.0 means this framework on one TPU chip beats that.
 
-Weights are synthesized directly on device in the Pallas kernel's int8-plane layout
-(random int8 values in [-8, 8) + f32 block scales, 1 B/weight + K/8 B/row of HBM) —
-decode cost is layout/bandwidth-bound and independent of weight values, so this measures
-exactly what a converted checkpoint would.
+Weights are synthesized directly on device in the 4-bit split-plane kernel layout
+(random packed nibbles + f16 block scales — the reference's exact Q40 HBM density,
+0.5625 B/weight, src/quants.hpp:17-20). Decode cost is layout/bandwidth-bound and
+independent of weight values, so this measures exactly what a converted checkpoint
+costs. --layout i8 benches the older int8-plane kernel for comparison.
 
-Usage: python bench.py [--small] [--steps N] [--tp N]
+Usage: python bench.py [--small] [--steps N] [--tp N] [--layout i4p|i8]
+                       [--device-loop N] [--window W]
 """
 
 import argparse
@@ -51,28 +56,35 @@ SMALL = dict(arch_type=ArchType.LLAMA, dim=512, hidden_dim=1408, n_layers=4,
              rope_type=RopeType.LLAMA)
 
 
-def synth_q40(key, shape, on_tpu: bool):
+def synth_q40(key, shape, layout: str):
     """Random Q40 tensor synthesized on device, already in the kernel's layout."""
     out, in_ = shape[-2], shape[-1]
     lead = shape[:-2]
     k1, k2 = jax.random.split(key)
-    scales = (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
-              + 0.001)
-    if on_tpu:
+    if layout == "i4p":
+        data = jax.random.randint(k1, (*lead, out, in_ // 2), 0, 256, jnp.uint8)
+        scales = (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
+                  + 0.001).astype(jnp.float16)
+        return QTensor(FloatType.Q40, data, scales, layout="i4p")
+    if layout == "i8":
         vals = jax.random.randint(k1, (*lead, out, in_), -8, 8, jnp.int8)
+        scales = (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
+                  + 0.001)
         return QTensor(FloatType.Q40, vals, scales, layout="i8")
     packed = jax.random.randint(k1, (*lead, out, in_ // QK, 16), 0, 256, jnp.uint8)
-    return QTensor(FloatType.Q40, packed, scales.astype(jnp.float16))
+    scales = (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
+              + 0.001).astype(jnp.float16)
+    return QTensor(FloatType.Q40, packed, scales)
 
 
-def synth_params(spec: ModelSpec, on_tpu: bool):
+def synth_params(spec: ModelSpec, layout: str):
     key = jax.random.PRNGKey(0)
     blocks = {}
     for name, (shape, quantized) in block_tensor_shapes(spec).items():
         key, sub = jax.random.split(key)
         full = (spec.n_layers, *shape)
         if quantized:
-            blocks[name] = synth_q40(sub, full, on_tpu)
+            blocks[name] = synth_q40(sub, full, layout)
         else:
             blocks[name] = jnp.ones(full, jnp.float32)
     key, k1, k2 = jax.random.split(key, 3)
@@ -80,8 +92,19 @@ def synth_params(spec: ModelSpec, on_tpu: bool):
         "embedding": jax.random.normal(k1, (spec.vocab_size, spec.dim), jnp.float32) * 0.02,
         "blocks": blocks,
         "rms_final": jnp.ones((spec.dim,), jnp.float32),
-        "wcls": synth_q40(k2, (spec.vocab_size, spec.dim), on_tpu),
+        "wcls": synth_q40(k2, (spec.vocab_size, spec.dim), layout),
     }
+
+
+def params_bytes(params) -> int:
+    """Weight + scale bytes decode streams per token (embedding row reads excluded)."""
+    total = 0
+    for t in list(params["blocks"].values()) + [params["wcls"]]:
+        if isinstance(t, QTensor):
+            total += t.nbytes()
+        else:
+            total += t.nbytes
+    return total
 
 
 def main():
@@ -89,42 +112,72 @@ def main():
     ap.add_argument("--small", action="store_true", help="tiny model (CI smoke)")
     ap.add_argument("--steps", type=int, default=32)
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--layout", choices=("i4p", "i8"), default="i4p")
+    ap.add_argument("--window", type=int, default=256,
+                    help="attention window bucket (cache positions decode reads)")
+    ap.add_argument("--device-loop", type=int, default=0, metavar="N",
+                    help="use the on-device scan loop, N tokens per dispatch")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
     spec = ModelSpec(**(SMALL if args.small else LLAMA2_7B)).resolved()
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    layout = args.layout if on_tpu else "planar"
+    window = min(args.window, spec.seq_len)
+    # keep the documented start_pos + T <= attn_window contract: grow the bucket to
+    # cover every decoded position (warm steps + timed steps, or the loop dispatches)
+    steps_end = 4 + args.steps if args.device_loop <= 0 else (
+        args.device_loop * (max(args.steps // args.device_loop, 1) + 1))
+    while window < min(steps_end, spec.seq_len):
+        window *= 2
+    window = None if window >= spec.seq_len else window
 
     mesh = make_mesh(tp=args.tp)
-    params = synth_params(spec, on_tpu)
+    params = synth_params(spec, layout)
     params = shard_params(params, mesh, spec)
     rope = RopeTables.create(spec)
-    # per-token dispatch with donated KV caches: XLA aliases the donated buffers so the
-    # per-layer cache restack is in-place. (The on-device scan loop in
-    # runtime/device_loop.py dispatches once per chunk, but loop-carried caches lose
-    # that aliasing and ping-pong ~2x cache bytes per token — measured strictly slower
-    # here, so the host loop is the benchmark path.)
-    step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
-                                donate_cache=True)
+    wbytes = params_bytes(params)
     kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
 
     # NOTE: on the axon TPU tunnel, block_until_ready() returns before the device is
     # actually done; only a device->host transfer is an honest fence. Materialize a
     # logit on the host to close each timed region.
     tok = jnp.asarray([[1]], jnp.int32)
-    logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile + warm
-    np.asarray(logits[0, 0, 0])
-    for i in range(3):  # warm steps
-        logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(1 + i))
-    np.asarray(logits[0, 0, 0])
 
-    t0 = time.perf_counter()
-    pos = 4
-    for _ in range(args.steps):
-        logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(pos))
-        pos += 1
-    np.asarray(logits[0, 0, 0])
-    dt = (time.perf_counter() - t0) / args.steps
+    if args.device_loop > 0:
+        from distributed_llama_tpu.runtime.device_loop import make_decode_loop
+
+        chunk = args.device_loop
+        loop = make_decode_loop(spec, mesh, params, chunk, mode="greedy", dtype=dtype,
+                                use_pallas=on_tpu, attn_window=window)
+        key = jax.random.PRNGKey(0)
+        pos = 0
+        toks, _, kc, vc = loop(params, rope, 1, kc, vc, pos, key)  # compile + warm
+        np.asarray(toks)
+        pos += chunk
+        n_disp = max(args.steps // chunk, 1)
+        t0 = time.perf_counter()
+        for _ in range(n_disp):
+            toks, _, kc, vc = loop(params, rope, 1, kc, vc, pos, key)
+            pos += chunk
+        np.asarray(toks)
+        dt = (time.perf_counter() - t0) / (n_disp * chunk)
+    else:
+        step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
+                                    donate_cache=True, attn_window=window)
+        logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile + warm
+        np.asarray(logits[0, 0, 0])
+        for i in range(3):  # warm steps
+            logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(1 + i))
+        np.asarray(logits[0, 0, 0])
+
+        t0 = time.perf_counter()
+        pos = 4
+        for _ in range(args.steps):
+            logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(pos))
+            pos += 1
+        np.asarray(logits[0, 0, 0])
+        dt = (time.perf_counter() - t0) / args.steps
 
     tok_s = 1.0 / dt
     name = "llama2_7b_q40_decode_tok_s" if not args.small else "small_q40_decode_tok_s"
@@ -133,6 +186,12 @@ def main():
         "value": round(tok_s, 3),
         "unit": "tok/s",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+        "ms_per_token": round(dt * 1e3, 3),
+        "weight_gb": round(wbytes / 1e9, 3),
+        "achieved_gbps": round(wbytes / 1e9 / dt, 1),
+        "layout": layout,
+        "attn_window": window or spec.seq_len,
+        "device_loop": args.device_loop,
     }))
 
 

@@ -42,6 +42,25 @@ GROK_EMBEDDING_SCALE = 78.38367176906169  # grok1-tasks.cpp:13
 GROK_LOGITS_SCALE = 0.5773502691896257  # grok1-tasks.cpp:272
 
 
+def _localize_qtensors(params):
+    """Reset i4p col-group metadata for shard-local execution.
+
+    Col-sharded i4p tensors are packed per TP column group precisely so that each
+    shard's slice is ONE self-contained split-plane pack; inside shard_map the local
+    QTensor therefore has groups=1 physically, but the aux metadata (static through
+    device_put/tree ops) still says groups=tp. Fix it up so dequantize/kernels see the
+    local truth."""
+    from ..quants import QTensor
+
+    def fix(t):
+        if isinstance(t, QTensor) and t.layout == "i4p" and t.groups != 1:
+            return QTensor(t.ftype, t.data, t.scales, layout="i4p", groups=1)
+        return t
+
+    return jax.tree_util.tree_map(fix, params,
+                                  is_leaf=lambda x: isinstance(x, QTensor))
+
+
 def _act(spec: ModelSpec):
     return silu if spec.hidden_act == HiddenAct.SILU else gelu_tanh
 
@@ -58,12 +77,23 @@ def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> 
     return psum(x, axis_name, compress=compress)
 
 
-def _attention(x, bp, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos, positions,
-               axis_name, sp_axis_name, sp_size, use_pallas, compress):
-    """Sharded attention sub-block. Head counts in bp may be TP-local slices; the cache
-    sequence axis may be sp-sharded (ring attention)."""
+def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos,
+               positions, axis_name, sp_axis_name, sp_size, use_pallas, compress,
+               window):
+    """Sharded attention sub-block against the FULL stacked caches (L, B, hk, S, hs).
+
+    Head counts in bp may be TP-local slices; the cache sequence axis may be sp-sharded
+    (ring attention). The layer's new k/v rows are written in place at
+    (layer_idx, :, :, pos) — decode's cache WRITE is T rows, and its READ is only the
+    first `window` positions (a static bucket >= pos+T chosen by the caller), so cache
+    HBM traffic scales with the live context, not the allocated seq_len. The reference
+    gets the same effect for free because its attention loop runs 0..pos
+    (llama2-tasks.cpp:62-93); with XLA's static shapes the window bucket is the
+    equivalent lever.
+    """
     b, t, _ = x.shape
     hs = spec.head_size
+    _, _, hk, s, _ = kc.shape
     xb = rmsnorm(x, bp["rms_att"], spec.norm_eps)
     q = qmatmul(xb, bp["wq"], use_pallas=use_pallas)
     k = qmatmul(xb, bp["wk"], use_pallas=use_pallas)
@@ -75,14 +105,37 @@ def _attention(x, bp, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos, posi
     v = v.reshape(b, t, hk_local, hs)
     if sp_axis_name is not None and sp_size > 1:
         # sequence parallelism: each sp member keeps its slice of the cache and the
-        # KV blocks rotate around the ring (ops/ring_attention.py)
-        kc, vc = update_kv_cache_sharded(kc, vc, k, v, start_pos,
+        # KV blocks rotate around the ring (ops/ring_attention.py). Layer slice out,
+        # sharded update, full-layer write-back (the ring path reads the whole local
+        # slice anyway).
+        kl = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
+        vl = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
+        kl, vl = update_kv_cache_sharded(kl, vl, k, v, start_pos,
                                          axis_name=sp_axis_name)
-        att = ring_attention(q, kc, vc, positions, axis_name=sp_axis_name,
+        att = ring_attention(q, kl, vl, positions, axis_name=sp_axis_name,
                              axis_size=sp_size)
+        kc = jax.lax.dynamic_update_slice(kc, kl[None], (layer_idx, 0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, vl[None], (layer_idx, 0, 0, 0, 0))
+    elif start_pos.ndim == 1:
+        # per-row offsets (continuous batching): vmap'd per-row write on the layer
+        # slice, then full-layer write-back
+        kl = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
+        vl = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
+        kl, vl = update_kv_cache(kl, vl, k, v, start_pos)
+        win = window or s
+        att = gqa_attention(q, kl[:, :, :win], vl[:, :, :win], positions)
+        kc = jax.lax.dynamic_update_slice(kc, kl[None], (layer_idx, 0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, vl[None], (layer_idx, 0, 0, 0, 0))
     else:
-        kc, vc = update_kv_cache(kc, vc, k, v, start_pos)
-        att = gqa_attention(q, kc, vc, positions)
+        # common path: tiny in-place write at (layer, :, :, pos), windowed read
+        k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)[None]  # (1, B, hk, T, hs)
+        v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)[None]
+        kc = jax.lax.dynamic_update_slice(kc, k_t, (layer_idx, 0, 0, start_pos, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v_t, (layer_idx, 0, 0, start_pos, 0))
+        win = window or s
+        kw = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
+        vw = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
+        att = gqa_attention(q, kw, vw, positions)
     # col-parallel wo: local heads x local input slice -> partial (B, T, dim); psum merges
     attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas), axis_name, compress)
     return attn_out, kc, vc
@@ -148,12 +201,12 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
 
 
 def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions,
-           axis_name, sp_axis_name, sp_size, use_pallas, compress):
-    x = carry
-    bp, kc, vc = layer
-    attn_out, kc, vc = _attention(x, bp, spec, rope, kc, vc, start_pos, positions,
-                                  axis_name, sp_axis_name, sp_size, use_pallas,
-                                  compress)
+           axis_name, sp_axis_name, sp_size, use_pallas, compress, window):
+    x, kc, vc = carry
+    bp, layer_idx = layer
+    attn_out, kc, vc = _attention(x, bp, layer_idx, spec, rope, kc, vc, start_pos,
+                                  positions, axis_name, sp_axis_name, sp_size,
+                                  use_pallas, compress, window)
     if spec.arch_type == ArchType.GROK1:
         # grok: residual-join the *normalized* attention output (grokRmfFfn/Norm/Join)
         x = x + rmsnorm(attn_out, bp["rms_ffn"], spec.norm_eps)
@@ -167,14 +220,15 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
             x = x + _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
         else:
             x = x + _dense_ffn(xb, bp, spec, axis_name, use_pallas, compress)
-    return x, (kc, vc)
+    return (x, kc, vc), None
 
 
 def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             tokens: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             start_pos: jax.Array, *, dtype=jnp.float32, axis_name: str | None = None,
             sp_axis_name: str | None = None, sp_size: int = 1,
-            use_pallas: bool = False, compress_collectives: bool = False):
+            use_pallas: bool = False, compress_collectives: bool = False,
+            attn_window: int | None = None):
     """Run T tokens through the model against the KV cache.
 
     tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar
@@ -182,10 +236,20 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
     (continuous batching: each sequence decodes at its own position; the reference's
     single-slot pos has no analog). Returns (logits (B, T, vocab) f32, caches).
 
+    The caches are scan CARRIES, updated in place per layer at a dynamic layer index —
+    NOT scan xs/ys, which would restack (read+write) the full (L, B, hk, S, hs) buffers
+    every step (~4 GB/token at 7B/2048, measured as half the step time in round 3).
+
+    attn_window: static bound on cache positions attention reads (must cover
+    start_pos + T). None reads the full seq_len. Callers bucket it (Engine) so decode
+    cache traffic tracks the live context length.
+
     Equivalent of Inference::infer (tasks.cpp:173-184) for the whole token chunk; the
     embedding-row copy at tasks.cpp:176-177 is the take() below, the task loop is the scan.
     """
     t = tokens.shape[1]
+    if axis_name is not None:
+        params = _localize_qtensors(params)
     start_pos = jnp.asarray(start_pos)
     if start_pos.ndim == 1:
         assert sp_size == 1, "per-row start_pos is not supported with sp (ring) sharding"
@@ -199,9 +263,11 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
     block_fn = functools.partial(_block, spec=spec, rope=rope, start_pos=start_pos,
                                  positions=positions, axis_name=axis_name,
                                  sp_axis_name=sp_axis_name, sp_size=sp_size,
-                                 use_pallas=use_pallas, compress=compress_collectives)
-    x, (k_cache, v_cache) = jax.lax.scan(block_fn, x,
-                                         (params["blocks"], k_cache, v_cache))
+                                 use_pallas=use_pallas, compress=compress_collectives,
+                                 window=attn_window)
+    layer_ids = jnp.arange(spec.n_layers, dtype=jnp.int32)
+    (x, k_cache, v_cache), _ = jax.lax.scan(
+        block_fn, (x, k_cache, v_cache), (params["blocks"], layer_ids))
 
     x = rmsnorm(x, params["rms_final"], spec.norm_eps)
     logits = qmatmul(x, params["wcls"], use_pallas=use_pallas, out_dtype=jnp.float32)

@@ -86,10 +86,13 @@ def init_random_params(spec: ModelSpec, weights_ftype: FloatType = FloatType.F32
 
 _I8_CONVERTIBLE = (FloatType.Q40, FloatType.Q80)
 
-# per-layer tensors whose scan-sliced form is the 2-D matvec the q8 kernel consumes.
+# per-layer tensors whose scan-sliced form is the 2-D matvec the decode kernels consume.
 # MoE expert stacks (3-D per layer) and the router (use_pallas=False in forward) stay
-# planar: the kernel can't take them, and i8 planes would double their HBM for nothing.
+# planar: the kernel can't take them, and expanded layouts would grow their HBM for
+# nothing. Tensors in _COL_SHARDED get their in-axis TP-sliced (ColMatmulSlice), so the
+# i4p split-plane pack must be applied per column group (QTensor.to_i4p_layout).
 _DENSE_MATMULS = {"wq", "wk", "wv", "wo", "w1", "w2", "w3"}
+_COL_SHARDED = {"wo", "w2"}
 
 
 def _kernel_convertible(t: QTensor, stacked: bool) -> bool:
@@ -101,22 +104,33 @@ def _kernel_convertible(t: QTensor, stacked: bool) -> bool:
     return len(shape) == 2 and q8_shape_supported(*shape)
 
 
+def _decode_layout(t: QTensor, tp: int, col_sharded: bool) -> QTensor:
+    """Pick the decode-kernel layout for one weight: Q40 -> i4p split-plane nibbles
+    (0.5625 B/weight, the file's own density — pallas_q4 kernel); Q80 -> int8 planes
+    (pallas_q8 kernel). Falls back to i8 when the i4p alignment constraints don't hold."""
+    if t.ftype == FloatType.Q40:
+        k = t.shape[-1]
+        groups = tp if col_sharded else 1
+        if k % groups == 0 and (k // groups) % 64 == 0:
+            return t.to_i4p_layout(col_groups=groups)
+    return t.to_i8_layout()
+
+
 def prepare_for_pallas(params: Params, tp: int = 1) -> Params:
-    """Expand the dense matmul weights into int8 planes (QTensor.to_i8_layout) for the
-    Pallas MXU matvec kernel. Both tensor axes slice cleanly (quant blocks stay
-    32-aligned), so the layout is TP-agnostic; `tp` is accepted for API stability.
-    Tensors the kernel can't consume keep the packed planar layout (half the HBM)."""
-    del tp
+    """Repack the dense matmul weights into the Pallas decode-kernel layouts
+    (i4p packed nibbles for Q40, int8 planes for Q80). Row/col TP slices stay
+    32-block-aligned; col-sharded tensors are packed per TP column group so each
+    shard's slice is self-contained."""
     out: Params = {"embedding": params["embedding"], "blocks": {},
                    "rms_final": params["rms_final"]}
     for name, t in params["blocks"].items():
         if name in _DENSE_MATMULS and _kernel_convertible(t, stacked=True):
-            out["blocks"][name] = t.to_i8_layout()
+            out["blocks"][name] = _decode_layout(t, tp, name in _COL_SHARDED)
         else:
             out["blocks"][name] = t
     wcls = params["wcls"]
     if _kernel_convertible(wcls, stacked=False):
-        wcls = wcls.to_i8_layout()
+        wcls = _decode_layout(wcls, tp, col_sharded=False)
     out["wcls"] = wcls
     return out
 

@@ -29,10 +29,16 @@ def qmatmul(x: jax.Array, w: QTensor, *, use_pallas: bool = False,
             out_dtype=None) -> jax.Array:
     """y = x @ W^T for W of logical shape (out, in); x: (..., in) -> (..., out)."""
     if use_pallas and math.prod(x.shape[:-1]) == 1:
-        from .pallas_q8 import q8_decode_supported, q8_matvec
+        if w.layout == "i4p":
+            from .pallas_q4 import q4_decode_supported, q4_matvec
 
-        if q8_decode_supported(w):
-            return q8_matvec(x, w, out_dtype=out_dtype or x.dtype)
+            if w.groups == 1 and q4_decode_supported(w):
+                return q4_matvec(x, w, out_dtype=out_dtype or x.dtype)
+        else:
+            from .pallas_q8 import q8_decode_supported, q8_matvec
+
+            if q8_decode_supported(w):
+                return q8_matvec(x, w, out_dtype=out_dtype or x.dtype)
     wd = w.dequantize(dtype=x.dtype)
     y = jax.lax.dot_general(
         x, wd,

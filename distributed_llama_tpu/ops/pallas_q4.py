@@ -1,0 +1,118 @@
+"""Fused 4-bit split-plane quantized matvec — true-Q40-footprint decode kernel.
+
+The int8-plane kernel (ops/pallas_q8.py) spends 1 B/weight of HBM; decode is
+HBM-bandwidth-bound, so on a ~300 GB/s effective chip a 7B model costs ~25 ms/token in
+weight traffic alone. This kernel keeps weights PACKED at 4 bits (0.5 B/weight + f16
+scales = 0.5625 B/weight, the reference's own Q40 density, src/quants.hpp:17-20) and
+unpacks in VMEM with zero cross-lane shuffles:
+
+Layout "i4p" (split-plane packing, `QTensor.to_i4p_layout`):
+    data   uint8 (out, K/2):  byte j = q[j] | (q[j + K/2] << 4),  q = nibble+8 in [0,16)
+    scales f16   (out, K/32): the reference's per-block f16 deltas, bit-exact
+
+Unpacking byte j's low nibble yields element j and the high nibble element j + K/2 —
+both planes land in natural element order, so the unpack is 4 elementwise VPU ops per
+byte (and/shift/two subs) and the per-block scale structure is untouched. The dot is the
+same block-diagonal Xexp trick as pallas_q8 (P[n,b] = per-block int32 partial sums on
+the MXU), split into the two K/2 halves:
+
+    P = (lo - 8) @ Xexp[:K/2] + (hi - 8) @ Xexp[K/2:]
+    y[n] = sum_b scales[n,b] * sx[b] * P[n,b]
+
+This is the TPU descendant of matmulQ40vQ80 (src/funcs.cpp:287-396) at the reference's
+exact storage density; the reference unpacks nibbles per dot-product on NEON the same
+way, just 32 lanes at a time instead of 4096.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quants import QK, FloatType, QTensor
+
+
+def _matvec_kernel(xexp_ref, sx_ref, wp_ref, s_ref, o_ref):
+    wp = wp_ref[:]  # (bn, K/2) uint8
+    lo = (wp & jnp.uint8(0x0F)).astype(jnp.int8) - 8  # elements [0, K/2)
+    hi = (wp >> 4).astype(jnp.int8) - 8  # elements [K/2, K)
+    kh = wp.shape[1]
+    # P[n, b] = sum_{j in block b} w8[n, j] * xq[j] — int8 x int8 -> int32 on the MXU
+    p = jax.lax.dot_general(lo, xexp_ref[:kh], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    p += jax.lax.dot_general(hi, xexp_ref[kh:], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.int32)
+    y = (s_ref[:].astype(jnp.float32) * sx_ref[:]) * p.astype(jnp.float32)
+    o_ref[:] = jnp.sum(y, axis=1, keepdims=True)
+
+
+def _pick_bn(n: int, k: int, budget_bytes: int = 3 << 20) -> int:
+    """Largest 128-multiple row-block whose (bn, K/2) packed block fits the VMEM budget
+    (double-buffered by Pallas)."""
+    if n <= 128:
+        return n
+    cap = max(budget_bytes // max(k // 2, 1), 128)
+    return max(min(cap, n) // 128 * 128, 128)
+
+
+_XEXP_VMEM_LIMIT = 9 << 20
+
+
+def q4_shape_supported(n: int, k: int) -> bool:
+    nb = k // QK
+    return k % (2 * QK) == 0 and k * nb <= _XEXP_VMEM_LIMIT
+
+
+def q4_decode_supported(w: QTensor) -> bool:
+    """Whether the fused 4-bit matvec kernel can run this weight tensor on TPU."""
+    if w.layout != "i4p" or w.data.ndim != 2:
+        return False
+    n, kh = w.data.shape
+    return q4_shape_supported(n, kh * 2)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q4_matvec(xexp, sx, wp, scales, *, interpret: bool = False):
+    """y (n, 1) f32 from block-diagonal Xexp (K, nb) int8, sx (1, nb) f32,
+    packed nibbles (n, K/2) uint8, scales (n, nb) f16."""
+    k, nb = xexp.shape
+    n, kh = wp.shape
+    assert kh * 2 == k and scales.shape == (n, nb) and nb * QK == k, (
+        xexp.shape, wp.shape, scales.shape)
+    bn = _pick_bn(n, k)
+    return pl.pallas_call(
+        _matvec_kernel,
+        grid=(pl.cdiv(n, bn),),
+        in_specs=[
+            pl.BlockSpec((k, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, kh), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        interpret=interpret,
+    )(xexp, sx, wp, scales)
+
+
+def q4_matvec(x: jax.Array, w: QTensor, *, out_dtype=None,
+              interpret: bool | None = None) -> jax.Array:
+    """Decode-path matmul: x (..., K) with leading dims multiplying to 1, i4p-layout
+    QTensor (N, K) -> (..., N)."""
+    if w.layout != "i4p":
+        raise ValueError("q4_matvec needs i4p-layout weights (QTensor.to_i4p_layout)")
+    assert w.data.ndim == 2, w.data.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    from .pallas_q8 import _expand_q80
+
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    nb = k // QK
+    xexp, sx = _expand_q80(x.reshape(k), nb)
+    y = _q4_matvec(xexp, sx, w.data, w.scales, interpret=interpret)
+    return y.reshape(*lead, y.shape[0]).astype(out_dtype or x.dtype)

@@ -110,11 +110,14 @@ def init_sharded_kv_cache(spec: ModelSpec, mesh: Mesh, batch: int = 1, dtype=Non
 
 def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
                          dtype=None, use_pallas: bool = False,
-                         compress_collectives: bool = False, donate_cache: bool = True):
+                         compress_collectives: bool = False, donate_cache: bool = True,
+                         attn_window: int | None = None):
     """Build the jitted SPMD forward step over the mesh's tp axis.
 
     Returns fn(params, rope, tokens, k_cache, v_cache, start_pos) ->
     (logits, k_cache, v_cache). Cache buffers are donated (in-place update in HBM).
+    attn_window statically bounds the cache positions attention reads (see
+    models.forward.forward); callers must keep start_pos + T <= attn_window.
     """
     import jax.numpy as jnp
 
@@ -122,6 +125,8 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
     sp = mesh.shape.get(AXIS_SP, 1)
     check_divisibility(spec, tp, sp)
     dtype = dtype or jnp.float32
+    if sp > 1:
+        attn_window = None  # ring attention always walks the full sharded cache
 
     param_specs = _expand_pspec_tree(params, param_pspecs(params))
     kv_spec = kv_cache_pspec_for_mesh(mesh)
@@ -129,7 +134,8 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
     fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
-                            compress_collectives=compress_collectives)
+                            compress_collectives=compress_collectives,
+                            attn_window=attn_window)
     rope_type = spec.rope_type
 
     def step(p, rope_cos, rope_sin, tokens, kc, vc, start_pos):

@@ -233,7 +233,13 @@ class QTensor:
     ftype: FloatType
     data: jax.Array | np.ndarray  # dense values, Q40 packed u8, or Q80 int8
     scales: jax.Array | np.ndarray | None = None  # f16 per-block scales for Q40/Q80
-    layout: str = "planar"  # "planar" | "i8" (int8 planes for the MXU kernel, to_i8_layout)
+    # "planar" | "i8" (int8 planes, to_i8_layout) | "i4p" (split-plane packed nibbles,
+    # to_i4p_layout — true Q40 HBM density for the pallas_q4 decode kernel)
+    layout: str = "planar"
+    # i4p only: number of column groups the split-plane pack was applied within
+    # (= the TP degree for in-axis-sharded tensors, so each shard's slice is a
+    # self-contained pack). 1 elsewhere.
+    groups: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
@@ -242,24 +248,26 @@ class QTensor:
             return tuple(self.data.shape)
         if self.layout == "i8":
             return tuple(self.data.shape)
+        if self.layout == "i4p":
+            return (*self.data.shape[:-1], self.data.shape[-1] * 2)
         if self.ftype in (FloatType.Q40, FloatType.Q80):
             return (*self.data.shape[:-2], self.data.shape[-2] * QK)
         raise ValueError(self.ftype)
 
     def tree_flatten(self):
         if self.scales is None:
-            return (self.data,), (self.ftype, False, self.layout)
-        return (self.data, self.scales), (self.ftype, True, self.layout)
+            return (self.data,), (self.ftype, False, self.layout, self.groups)
+        return (self.data, self.scales), (self.ftype, True, self.layout, self.groups)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        ftype, has_scales, layout = aux
+        ftype, has_scales, layout, groups = aux
         if has_scales:
             data, scales = children
         else:
             (data,) = children
             scales = None
-        return cls(ftype=ftype, data=data, scales=scales, layout=layout)
+        return cls(ftype=ftype, data=data, scales=scales, layout=layout, groups=groups)
 
     def to_i8_layout(self) -> "QTensor":
         """Expand planar Q40/Q80 into int8 planes for the MXU matvec kernel (pallas_q8).
@@ -289,6 +297,48 @@ class QTensor:
         scales32 = np.asarray(self.scales, dtype=np.float32)
         return QTensor(self.ftype, data, scales32, layout="i8")
 
+    def to_i4p_layout(self, col_groups: int = 1) -> "QTensor":
+        """Repack planar Q40 into split-plane nibbles for the 4-bit MXU matvec kernel
+        (ops/pallas_q4.py): data uint8 (..., K/2) with byte j = q[j] | (q[j+K/2] << 4)
+        where q = nibble+8; scales f16 (..., K/32) kept bit-exact from the file.
+
+        Both unpacked planes land in natural element order, so the kernel needs no
+        cross-lane shuffles. Same HBM bytes as the reference's BlockQ40 stream
+        (src/quants.hpp:17-20).
+
+        col_groups: split-plane pack WITHIN each of `col_groups` equal column groups —
+        required for in-axis (ColMatmulSlice) TP sharding, where each shard must receive
+        a self-contained split-plane pack of its own K/col_groups columns. Row-sharded
+        tensors use col_groups=1. Each group's K_local must satisfy K_local % 64 == 0
+        so the plane boundary stays on a quant-block boundary.
+        """
+        assert self.layout == "planar" and self.ftype == FloatType.Q40, (
+            self.layout, self.ftype)
+        packed = np.asarray(self.data)  # (..., nb, 16)
+        lo = (packed & 0x0F).astype(np.uint8)  # block elements 0..15
+        hi = (packed >> 4).astype(np.uint8)  # block elements 16..31
+        q = np.concatenate([lo, hi], axis=-1)  # (..., nb, 32) natural order, in [0,16)
+        k = q.shape[-2] * QK
+        lead = q.shape[:-2]
+        kl = k // col_groups
+        assert k % col_groups == 0 and kl % 64 == 0, (k, col_groups)
+        q = q.reshape(*lead, col_groups, kl)
+        data = q[..., : kl // 2] | (q[..., kl // 2 :] << 4)
+        data = data.reshape(*lead, k // 2)
+        return QTensor(self.ftype, data, np.asarray(self.scales, dtype=np.float16),
+                       layout="i4p", groups=col_groups)
+
+    def _i4p_unpack(self, xp):
+        """Split-plane nibbles -> natural-order values (..., K) minus the 8 offset."""
+        wp = self.data
+        kh = wp.shape[-1]
+        g = self.groups
+        wp = wp.reshape(*wp.shape[:-1], g, kh // g)
+        lo = xp.asarray((wp & 0x0F), dtype=xp.int8) - 8
+        hi = xp.asarray((wp >> 4), dtype=xp.int8) - 8
+        out = xp.concatenate([lo, hi], axis=-1)  # (..., g, K/g) natural within group
+        return out.reshape(*out.shape[:-2], kh * 2)
+
     @classmethod
     def from_float(cls, x: np.ndarray, ftype: FloatType) -> "QTensor":
         x = np.asarray(x)
@@ -311,6 +361,11 @@ class QTensor:
         if self.layout == "i8":
             return jnp_dequantize_i8(jnp.asarray(self.data), jnp.asarray(self.scales),
                                      dtype)
+        if self.layout == "i4p":
+            vals = self._i4p_unpack(jnp)
+            nb = self.scales.shape[-1]
+            g = vals.reshape(*vals.shape[:-1], nb, QK)
+            return jnp_dequantize_q80(g, jnp.asarray(self.scales), dtype)
         if self.ftype == FloatType.Q40:
             return jnp_dequantize_q40(jnp.asarray(self.data), jnp.asarray(self.scales), dtype)
         if self.ftype == FloatType.Q80:
@@ -323,6 +378,11 @@ class QTensor:
         if self.layout == "i8":
             nb = self.scales.shape[-1]
             g = np.asarray(self.data).reshape(*self.data.shape[:-1], nb, QK)
+            return dequantize_q80(g, np.asarray(self.scales))
+        if self.layout == "i4p":
+            vals = self._i4p_unpack(np)
+            nb = self.scales.shape[-1]
+            g = vals.reshape(*vals.shape[:-1], nb, QK)
             return dequantize_q80(g, np.asarray(self.scales))
         if self.ftype == FloatType.Q40:
             return dequantize_q40(np.asarray(self.data), np.asarray(self.scales))

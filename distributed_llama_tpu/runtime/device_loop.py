@@ -80,7 +80,8 @@ def device_sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
 
 def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str = "greedy",
                      dtype=None, use_pallas: bool = False,
-                     compress_collectives: bool = False, donate_cache: bool = True):
+                     compress_collectives: bool = False, donate_cache: bool = True,
+                     attn_window: int | None = None):
     """Build fn(params, rope, token, kc, vc, start_pos, key, temperature, topp) ->
     (tokens (n_steps,), last_logits (vocab,), kc, vc).
 
@@ -94,6 +95,8 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     assert mode in ("greedy", "sample"), mode
     dtype = dtype or jnp.float32
     sp = mesh.shape.get(AXIS_SP, 1)
+    if sp > 1:
+        attn_window = None  # ring attention always walks the full sharded cache
     param_specs = _expand_pspec_tree(params, param_pspecs(params))
     kv_spec = kv_cache_pspec_for_mesh(mesh)
     rope_type = spec.rope_type
@@ -101,7 +104,8 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
-                            compress_collectives=compress_collectives)
+                            compress_collectives=compress_collectives,
+                            attn_window=attn_window)
 
     def loop(p, rope_cos, rope_sin, token, kc, vc, start_pos, key, temperature, topp):
         rope = RopeTables(rope_cos, rope_sin, rope_type)

@@ -107,13 +107,41 @@ class Engine:
         self.params = shard_params(params, self.mesh, spec)
         self.rope = RopeTables.create(spec)
         self.batch = batch
-        self._step = make_sharded_forward(
-            spec, self.mesh, self.params, dtype=self.dtype, use_pallas=self.use_pallas,
-            compress_collectives=compress_collectives, donate_cache=True)
+        self._steps: dict[int | None, object] = {}  # attn_window bucket -> jitted step
         self.k_cache, self.v_cache = self._init_cache()
         self.pos = 0
-        self._decode_loops: dict[tuple[int, str], object] = {}  # (chunk, mode) -> loop
+        self._decode_loops: dict[tuple, object] = {}  # (chunk, mode, window) -> loop
+        self._loop_traffics: dict[tuple, object] = {}  # (chunk, mode) -> CollectiveTraffic
         self._measured_traffic = None  # lazy CollectiveTraffic of the T=1 decode step
+
+    # attention reads only the first `window` cache positions — a static bucket so
+    # decode cache traffic tracks the live context, not the allocated seq_len (the
+    # reference's 0..pos attention loop gets this for free, llama2-tasks.cpp:62-93).
+    # Buckets are powers of two from 256 up; each compiles once.
+    _WINDOW_MIN = 256
+
+    def _window_for(self, pos_end: int) -> int | None:
+        """Smallest window bucket covering cache positions [0, pos_end)."""
+        s = self.spec.seq_len
+        if self.sp > 1 or s <= self._WINDOW_MIN:
+            return None  # ring path reads the full sharded cache; tiny contexts too
+        w = self._WINDOW_MIN
+        while w < pos_end:
+            w *= 2
+        return None if w >= s else w
+
+    def _step_for(self, window: int | None):
+        if window not in self._steps:
+            self._steps[window] = make_sharded_forward(
+                self.spec, self.mesh, self.params, dtype=self.dtype,
+                use_pallas=self.use_pallas, compress_collectives=self.compress,
+                donate_cache=True, attn_window=window)
+        return self._steps[window]
+
+    @property
+    def _step(self):
+        """The full-window step (collective tracing / tests)."""
+        return self._step_for(None)
 
     @classmethod
     def load(cls, model_path: str, tokenizer_path: str | None = None, *,
@@ -182,7 +210,8 @@ class Engine:
         t = len(tokens)
         if self.pos + t > self.spec.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {t} > {self.spec.seq_len}")
-        logits, self.k_cache, self.v_cache = self._step(
+        step = self._step_for(self._window_for(self.pos + t))
+        logits, self.k_cache, self.v_cache = step(
             self.params, self.rope, jnp.asarray(tokens)[None, :], self.k_cache,
             self.v_cache, jnp.int32(self.pos))
         self.pos += t
@@ -247,32 +276,33 @@ class Engine:
     # device-loop generation (one dispatch per chunk of tokens)
     # ------------------------------------------------------------------
 
-    def _decode_loop(self, chunk: int, mode: str):
-        if (chunk, mode) not in self._decode_loops:
+    def _decode_loop(self, chunk: int, mode: str, window: int | None = None):
+        if (chunk, mode, window) not in self._decode_loops:
             from .device_loop import make_decode_loop
 
-            self._decode_loops[chunk, mode] = make_decode_loop(
+            self._decode_loops[chunk, mode, window] = make_decode_loop(
                 self.spec, self.mesh, self.params, chunk, mode=mode, dtype=self.dtype,
                 use_pallas=self.use_pallas,
-                compress_collectives=self.compress, donate_cache=True)
-        return self._decode_loops[chunk, mode]
+                compress_collectives=self.compress, donate_cache=True,
+                attn_window=window)
+        return self._decode_loops[chunk, mode, window]
 
     def _loop_traffic(self, chunk: int, mode: str, loop):
         """Measured collective traffic of the device-loop program itself (it is a
         different compiled program than the host step — its own trace, not the
         T=1 step's, covers `chunk` tokens). Computed only when the user opted into
         measurement via collective_stats() — tracing a large model costs seconds."""
-        key = ("loop", chunk, mode)
-        if key not in self._decode_loops:
+        key = (chunk, mode)
+        if key not in self._loop_traffics:
             from ..parallel.hlo_stats import jaxpr_collective_traffic
 
             closed = jax.make_jaxpr(loop)(
                 self.params, self.rope, jnp.int32(1), self.k_cache, self.v_cache,
                 jnp.int32(0), jax.random.PRNGKey(0), jnp.float32(0.0),
                 jnp.float32(0.9))
-            self._decode_loops[key] = jaxpr_collective_traffic(
+            self._loop_traffics[key] = jaxpr_collective_traffic(
                 closed, dict(self.mesh.shape))
-        return self._decode_loops[key]
+        return self._loop_traffics[key]
 
     def generate_chunked(self, prompt_tokens: list[int], max_tokens: int, sampler,
                          on_token=None, stop_check=None, chunk: int = 16,
@@ -317,7 +347,7 @@ class Engine:
             # always run the compiled full-chunk program; a short tail (want < chunk)
             # just truncates the emitted tokens — cache entries past pos are dead and
             # overwritten by later writes at those positions
-            loop = self._decode_loop(chunk, mode)
+            loop = self._decode_loop(chunk, mode, self._window_for(self.pos + chunk))
             if self._measured_traffic is not None and stats.traffic_source != "measured":
                 self._fill_traffic(stats, self._loop_traffic(chunk, mode, loop),
                                    per_tokens=chunk)

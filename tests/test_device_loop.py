@@ -77,7 +77,7 @@ def test_device_loop_context_end_tail():
     assert got == want
     assert eng.pos <= spec.seq_len
     # only the full-size chunk (plus mode) was ever compiled for the scan loop
-    assert all(c == 16 for c, _ in eng._decode_loops)
+    assert all(c == 16 for c, _, _ in eng._decode_loops)
 
 
 def test_device_sample_greedy_and_topp():

@@ -1,0 +1,123 @@
+"""Pallas q4 (split-plane packed nibble) kernel tests — interpret mode on CPU.
+
+The i4p layout keeps the reference's exact Q40 HBM density (src/quants.hpp:17-20);
+these tests pin (a) the layout round-trip, (b) the column-group packing that makes
+in-axis TP slices self-contained, (c) kernel-vs-oracle numerics, and (d) the windowed
+forward being exactly equivalent to the full-cache forward.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_tpu.models.forward import forward, init_kv_cache
+from distributed_llama_tpu.models.params import init_random_params, prepare_for_pallas
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.ops.pallas_q4 import q4_matvec
+from distributed_llama_tpu.ops.rope import RopeTables
+from distributed_llama_tpu.quants import QK, FloatType, QTensor
+
+
+def _to_jnp(t: QTensor) -> QTensor:
+    return jax.tree_util.tree_map(jnp.asarray, t)
+
+
+def test_i4p_roundtrip_exact():
+    rng = np.random.RandomState(3)
+    w = QTensor.from_float(rng.randn(64, 256).astype(np.float32), FloatType.Q40)
+    wi = w.to_i4p_layout()
+    assert wi.data.shape == (64, 128) and wi.scales.dtype == np.float16
+    np.testing.assert_array_equal(wi.to_numpy(), w.to_numpy())
+    np.testing.assert_allclose(np.asarray(wi.dequantize(jnp.float32)), w.to_numpy(),
+                               atol=1e-6)
+
+
+def test_i4p_col_groups_make_shards_self_contained():
+    """Slicing a col_groups=G i4p tensor along the packed axis into G parts must give
+    each shard the exact i4p pack of its own natural column slice — the property that
+    lets device_put shard in-axis (ColMatmulSlice) weights without repacking."""
+    rng = np.random.RandomState(4)
+    n, k, g = 16, 512, 4
+    w = QTensor.from_float(rng.randn(n, k).astype(np.float32), FloatType.Q40)
+    grouped = w.to_i4p_layout(col_groups=g)
+    full = w.to_numpy()
+    kl, khl, nbl = k // g, k // (2 * g), (k // QK) // g
+    for s in range(g):
+        shard = QTensor(grouped.ftype, grouped.data[:, s * khl:(s + 1) * khl],
+                        grouped.scales[:, s * nbl:(s + 1) * nbl], layout="i4p")
+        np.testing.assert_array_equal(shard.to_numpy(), full[:, s * kl:(s + 1) * kl])
+
+
+def test_q4_matvec_matches_oracle():
+    rng = np.random.RandomState(7)
+    n, k = 128, 512
+    w = QTensor.from_float((rng.randn(n, k) * 0.05).astype(np.float32), FloatType.Q40)
+    wi = _to_jnp(w.to_i4p_layout())
+    x = jnp.asarray(rng.randn(1, k).astype(np.float32)).astype(jnp.bfloat16)
+    want = np.asarray(x, np.float32) @ w.to_numpy().T
+    got = np.asarray(q4_matvec(x, wi, interpret=True), np.float32)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 0.02, rel  # Q80 activation quantization error scale
+
+
+def test_q4_matvec_agrees_with_q8_kernel():
+    """Same weights through the 4-bit packed kernel and the int8-plane kernel must be
+    bit-identical modulo f16-vs-f32 scale precision (both quantize activations to the
+    same Q80 blocks)."""
+    from distributed_llama_tpu.ops.pallas_q8 import q8_matvec
+
+    rng = np.random.RandomState(9)
+    n, k = 64, 256
+    w = QTensor.from_float((rng.randn(n, k) * 0.05).astype(np.float32), FloatType.Q40)
+    x = jnp.asarray(rng.randn(1, k).astype(np.float32)).astype(jnp.bfloat16)
+    y4 = np.asarray(q4_matvec(x, _to_jnp(w.to_i4p_layout()), interpret=True), np.float32)
+    y8 = np.asarray(q8_matvec(x, _to_jnp(w.to_i8_layout()), interpret=True), np.float32)
+    np.testing.assert_allclose(y4, y8, rtol=2e-3, atol=1e-5)
+
+
+def test_q4_matvec_requires_i4p_layout():
+    w = QTensor.from_float(np.ones((8, 64), np.float32), FloatType.Q40)
+    with pytest.raises(ValueError, match="i4p"):
+        q4_matvec(jnp.ones((1, 64)), w, interpret=True)
+
+
+def test_prepare_for_pallas_picks_i4p_for_q40():
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=16,
+                     rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=7)
+    pp = prepare_for_pallas(params, tp=2)
+    assert pp["blocks"]["wq"].layout == "i4p" and pp["blocks"]["wq"].groups == 1
+    assert pp["blocks"]["w2"].layout == "i4p" and pp["blocks"]["w2"].groups == 2
+    assert pp["wcls"].layout == "i4p"
+    # Q80 weights keep the int8-plane layout (no 4-bit repack possible)
+    p80 = prepare_for_pallas(init_random_params(spec, FloatType.Q80, seed=7), tp=1)
+    assert p80["blocks"]["wq"].layout == "i8"
+
+
+def test_windowed_forward_equals_full():
+    """attn_window >= pos+T must give EXACTLY the full-cache forward's logits — the
+    positions mask already hides everything past pos, the window only trims dead reads."""
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=96, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=64,
+                     rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=5)
+    rope = RopeTables.create(spec)
+    tokens = jnp.asarray([[9, 2, 17, 4, 31]])
+
+    kc, vc = init_kv_cache(spec)
+    want, kcf, vcf = forward(params, spec, rope, tokens, kc, vc, jnp.int32(0))
+    kc, vc = init_kv_cache(spec)
+    got, kcw, vcw = forward(params, spec, rope, tokens, kc, vc, jnp.int32(0),
+                            attn_window=16)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # the cache itself is identical (same writes, windowing only affects reads)
+    np.testing.assert_array_equal(np.asarray(kcw), np.asarray(kcf))
+
+    # decode continuation at pos=5 with a window still matches
+    tok = jnp.asarray([[7]])
+    want2, _, _ = forward(params, spec, rope, tok, kcf, vcf, jnp.int32(5))
+    got2, _, _ = forward(params, spec, rope, tok, kcw, vcw, jnp.int32(5),
+                         attn_window=16)
+    np.testing.assert_array_equal(np.asarray(got2), np.asarray(want2))
