@@ -123,7 +123,7 @@ def main():
     spec = ModelSpec(**(SMALL if args.small else LLAMA2_7B)).resolved()
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     layout = args.layout if on_tpu else "planar"
-    window = min(args.window, spec.seq_len)
+    window = min(max(args.window, 64), spec.seq_len)
     # keep the documented start_pos + T <= attn_window contract: grow the bucket to
     # cover every decoded position (warm steps + timed steps, or the loop dispatches)
     steps_end = 4 + args.steps if args.device_loop <= 0 else (

@@ -54,8 +54,26 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                    help="decode CHUNK tokens per dispatch with the on-device scan loop "
                         "(runtime/device_loop.py); 0 = per-token host loop")
     p.add_argument("--nthreads", type=int, default=None, help="ignored (XLA owns the chip)")
-    p.add_argument("--kv-cache-storage", default=None, help="ignored (KV lives in HBM)")
+    p.add_argument("--kv-cache-storage", default=None, choices=["ram", "disc"],
+                   help="reference compat flag. 'disc' (mmap'd disk KV cache, "
+                        "transformer.cpp:312-318) is NOT supported on TPU — the cache "
+                        "lives in HBM; shard the sequence axis across chips with --sp "
+                        "for contexts that overflow one chip (see README)")
     return p
+
+
+def check_kv_storage(args) -> None:
+    """The reference's `--kv-cache-storage disc` spills the KV cache to mmap'd disk
+    files (src/transformer.cpp:312-318, utils.cpp:50-67) — an out-of-core valve for
+    small-RAM CPU nodes. On TPU the cache must sit in HBM to be usable by the chip at
+    all; paging it over the ~PCIe-class tunnel would be orders of magnitude slower than
+    decode itself. The TPU-native valve is sequence-parallel cache sharding (--sp,
+    ring attention over ICI). Warn loudly instead of silently accepting."""
+    if args.kv_cache_storage == "disc":
+        print("⚠️  --kv-cache-storage disc is not supported on TPU: the KV cache lives "
+              "in HBM.\n⚠️  For contexts larger than one chip's HBM, shard the cache "
+              "sequence axis with --sp N (ring attention); see README §long-context.",
+              file=sys.stderr)
 
 
 _FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
@@ -114,10 +132,16 @@ def mode_inference(args) -> None:
                                       device_loop_chunk=args.device_loop)
     text = b"".join(pieces).decode("utf-8", errors="replace")
     print(text)
-    # per-token stats table like dllama.cpp:76-93
+    # per-token stats table like dllama.cpp:76-93. The reference's columns are G(total),
+    # I(inference), T(root socket transfer) (utils.cpp:215-218). Here I = the on-device
+    # step INCLUDING the logits device->host copy (the only honest fence on the tunnel);
+    # ICI collective time is fused into the compiled step and cannot be split out at
+    # runtime, so the third column is H = host sampling/bookkeeping ms — labeled as
+    # what it is rather than printed as "transfer".
     for i, (g, inf) in enumerate(zip(stats.token_ms, stats.infer_ms)):
-        print(f"🔶 G {g:7.2f} ms I {inf:7.2f} ms T {g - inf:7.2f} ms "
+        print(f"🔶 G {g:7.2f} ms I {inf:7.2f} ms H {g - inf:7.2f} ms "
               f"S {stats.sent_kbytes_per_token:8.0f} kB R {stats.recv_kbytes_per_token:8.0f} kB {pieces[i].decode('utf-8', 'replace')}")
+    print("Columns: G total/token, I device step (incl. logits copy), H host sampling;")
     print(f"S/R source:          {stats.traffic_source} per-device ring bytes")
     print(f"Generated tokens:    {stats.generated_tokens}")
     print(f"Avg tokens / second: {stats.tokens_per_second:.2f}")
@@ -195,6 +219,7 @@ def main(argv=None) -> None:
 
     apply_platform_env()
     args = build_parser().parse_args(argv)
+    check_kv_storage(args)
     {"inference": mode_inference, "generate": mode_generate, "chat": mode_chat}[args.mode](args)
 
 

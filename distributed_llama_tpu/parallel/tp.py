@@ -35,7 +35,7 @@ def _expand_pspec_tree(params: dict[str, Any], pspecs: dict[str, Any]):
         elif isinstance(v, QTensor):
             spec = pspecs[k]
             out[k] = QTensor(v.ftype, spec, spec if v.scales is not None else None,
-                             layout=v.layout)
+                             layout=v.layout, groups=v.groups)
         else:
             out[k] = pspecs[k]
     return out

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Minimal OpenAI-compatible client for the dllama-api server — the counterpart of the
+reference's examples/chat-api-client.js (same endpoint, same request shape; Python
+because this image carries no Node runtime).
+
+Usage:
+  1. Start the server:
+       python -m distributed_llama_tpu.apps.api_server --model m.m --tokenizer t.t --port 9990
+  2. Run this script:
+       python examples/chat-api-client.py            # non-streaming
+       python examples/chat-api-client.py --stream   # SSE streaming
+
+HOST/PORT env vars override the default 127.0.0.1:9990.
+"""
+
+import argparse
+import json
+import os
+import urllib.request
+
+HOST = os.environ.get("HOST", "127.0.0.1")
+PORT = int(os.environ.get("PORT", "9990"))
+URL = f"http://{HOST}:{PORT}/v1/chat/completions"
+
+
+def chat(messages, max_tokens=64, stream=False, temperature=0.7):
+    body = json.dumps({
+        "messages": messages,
+        "temperature": temperature,
+        "max_tokens": max_tokens,
+        "stream": stream,
+    }).encode()
+    req = urllib.request.Request(
+        URL, data=body, headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req)
+    if not stream:
+        return json.loads(resp.read())["choices"][0]["message"]["content"]
+    # SSE: one `data: {...}` chunk per token, terminated by `data: [DONE]`
+    text = []
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data:"):
+            continue
+        payload = line[5:].strip()
+        if payload == "[DONE]":
+            break
+        delta = json.loads(payload)["choices"][0]["delta"]
+        piece = delta.get("content", "")
+        print(piece, end="", flush=True)
+        text.append(piece)
+    print()
+    return "".join(text)
+
+
+def ask(system, user, max_tokens, stream):
+    print(f"> system: {system}")
+    print(f"> user: {user}")
+    messages = [{"role": "system", "content": system},
+                {"role": "user", "content": user}]
+    out = chat(messages, max_tokens=max_tokens, stream=stream)
+    if not stream:
+        print(out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stream", action="store_true")
+    ap.add_argument("--max-tokens", type=int, default=64)
+    args = ap.parse_args()
+    ask("You are an excellent math teacher.", "What is 1 + 2?",
+        args.max_tokens, args.stream)
+    ask("You are a helpful assistant.", "Say hello.", args.max_tokens, args.stream)
+
+
+if __name__ == "__main__":
+    main()

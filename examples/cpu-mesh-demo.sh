@@ -1,0 +1,25 @@
+#!/bin/bash
+# Multi-device demo without TPU hardware — the counterpart of the reference's
+# examples/n-workers.sh (which screens N worker processes on localhost ports).
+#
+# Under SPMD there are no worker processes to spawn: the same program runs on every
+# mesh device and XLA lowers the psum/all_gather merge points to collectives. This
+# demo fakes an 8-chip host with XLA's virtual CPU devices and runs 4-way tensor
+# parallel x 2-way sequence parallel (ring attention) inference.
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL="${DLLAMA_MODEL:-/tmp/dlt_determinism/tiny.m}"
+TOKENIZER="${DLLAMA_TOKENIZER:-/tmp/dlt_determinism/tiny.t}"
+if [ ! -f "$MODEL" ]; then
+  mkdir -p /tmp/dlt_determinism
+  python examples/make_tiny_model.py /tmp/dlt_determinism
+fi
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+
+python -m distributed_llama_tpu.apps.dllama inference \
+  --model "$MODEL" --tokenizer "$TOKENIZER" \
+  --prompt "Eight devices, one program:" --steps 24 --temperature 0 \
+  --tp 4 --sp 2

@@ -1,0 +1,59 @@
+#!/bin/bash
+# KV-cache-filling determinism check — the counterpart of the reference's
+# examples/macbeth.sh (long greedy generation over a long prompt must reproduce the
+# exact same token sequence run over run).
+#
+# The reference runs against the downloaded Llama-3-8B checkpoint and notes its output
+# is only stable on one CPU family. Here, by default, the check runs against a
+# real-format Q40 checkpoint with seeded weights built by examples/make_tiny_model.py
+# (this container has zero egress, so the model zoo is unreachable); the whole
+# pipeline — converter-format .m/.t files, engine, windowed attention, tokenizer,
+# greedy sampler — is exercised and the output asserted stable across two runs and
+# against the committed expectation for the CPU backend.
+#
+# With a real checkpoint available (python launch.py tinyllama_1_1b_3t_q40), point
+# DLLAMA_MODEL/DLLAMA_TOKENIZER at it and the same determinism contract applies.
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL="${DLLAMA_MODEL:-/tmp/dlt_determinism/tiny.m}"
+TOKENIZER="${DLLAMA_TOKENIZER:-/tmp/dlt_determinism/tiny.t}"
+STEPS="${DLLAMA_STEPS:-96}"
+
+if [ ! -f "$MODEL" ]; then
+  mkdir -p /tmp/dlt_determinism
+  python examples/make_tiny_model.py /tmp/dlt_determinism
+fi
+
+PROMPT="The quick brown fox jumps over the lazy dog while seventy silent engineers
+measure the bandwidth of a systolic array at dawn. Every block of thirty-two nibbles
+carries one scale, every head attends to its own slice of the past, and the ring
+rotates until each shard has seen every key. Repeat the story until the cache is full:"
+
+run() {
+  python -m distributed_llama_tpu.apps.dllama inference \
+    --model "$MODEL" --tokenizer "$TOKENIZER" \
+    --prompt "$PROMPT" --steps "$STEPS" --temperature 0 --seed 12345 "$@" \
+    | grep -v '^🔶\|^⏩\|^💡\|^🔷\|^Columns\|^S/R\|tokens\|time:' || true
+}
+
+OUT1=$(run)
+OUT2=$(run)
+
+if [ "$OUT1" != "$OUT2" ]; then
+  echo "❌ DETERMINISM FAILURE: two identical runs disagreed"
+  diff <(echo "$OUT1") <(echo "$OUT2") || true
+  exit 1
+fi
+echo "✅ determinism: two runs produced identical output ($STEPS greedy tokens)"
+
+EXPECTED="examples/determinism_expected_cpu.txt"
+if [ -z "$DLLAMA_MODEL" ] && [ "${JAX_PLATFORMS:-}" = "cpu" ] && [ -f "$EXPECTED" ]; then
+  if [ "$OUT1" == "$(cat "$EXPECTED")" ]; then
+    echo "✅ determinism: output matches the committed CPU expectation"
+  else
+    echo "❌ output differs from $EXPECTED"
+    diff <(echo "$OUT1") "$EXPECTED" || true
+    exit 1
+  fi
+fi

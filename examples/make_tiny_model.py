@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Build a small REAL-FORMAT Q40 checkpoint + byte-level tokenizer for the examples.
+
+The container the framework is developed in has zero network egress, so the model zoo
+(launch.py) is unreachable; this builds a Llama-architecture model through the same
+file-format path a converted checkpoint takes (formats.mfile / formats.tfile — the
+byte-compatible `.m`/`.t` writers the converter uses), with deterministic seeded
+weights. Everything downstream of conversion — header parse, tensor mmap, Q40
+dequant, engine, tokenizer — is exactly the real-checkpoint code path.
+
+Usage: python examples/make_tiny_model.py [outdir]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_llama_tpu.formats.mfile import params_file_order, write_model
+from distributed_llama_tpu.formats.tfile import TokenizerData, write_tokenizer
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.quants import FloatType
+
+
+def main(outdir: str = "/tmp/dlt_determinism") -> None:
+    os.makedirs(outdir, exist_ok=True)
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=256, hidden_dim=512, n_layers=4,
+                     n_heads=8, n_kv_heads=4, vocab_size=260, seq_len=1024,
+                     rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=20260729)
+    write_model(os.path.join(outdir, "tiny.m"), spec,
+                params_file_order(spec, params), FloatType.Q40)
+
+    # byte-level tokenizer: ids 3..258 are the 256 raw bytes, so any prompt encodes
+    # via the reference's +3 byte-fallback rule (tokenizer.cpp:247-253)
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)] + [b"<pad>"]
+    scores = [0.0] * len(vocab)
+    td = TokenizerData(vocab=vocab, scores=scores, bos_id=1, eos_id=2,
+                       chat_template="{% llama2 %}[INST] {{content}} [/INST]")
+    write_tokenizer(os.path.join(outdir, "tiny.t"), td)
+    print(f"wrote {outdir}/tiny.m ({os.path.getsize(os.path.join(outdir, 'tiny.m'))} B) "
+          f"and {outdir}/tiny.t")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

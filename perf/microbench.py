@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Kernel & platform microbenchmarks — the evidence base for bench.py's numbers.
+
+Measures, on whatever backend JAX resolves (designed for the single TPU chip):
+  1. dispatch       — per-dispatch overhead of the host->device link (sync round trip
+                      and async chained), which bounds the per-token host-loop cost
+  2. stream         — steady-state HBM read bandwidth via a scan over stacked weights
+                      (single-op timings are meaningless when dispatch overhead is
+                      milliseconds; the scan amortizes it away)
+  3. matvec:q4/q8   — the two decode matvec kernels (ops/pallas_q4.py packed nibbles at
+                      0.5625 B/weight vs ops/pallas_q8.py int8 planes at 1.125 B/weight)
+                      on the Llama-2-7B hot shapes, reported as achieved GB/s
+  4. attention      — windowed vs full-seq_len cache read cost at 7B head geometry
+
+Each result prints as one JSON line. Timing uses a device->host transfer as the fence:
+on the axon TPU tunnel block_until_ready() returns early (see bench.py).
+
+Usage: python perf/microbench.py [--section dispatch|stream|matvec|attention] [--quick]
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distributed_llama_tpu.quants import QK, FloatType, QTensor  # noqa: E402
+
+
+def fence(x):
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(x)[0].ravel()[0]))
+
+
+def timed(fn, *args, reps=10):
+    fence(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn(*args)
+    fence(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def emit(**kw):
+    print(json.dumps(kw))
+
+
+def sec_dispatch(reps):
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    fence(f(x))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fence(f(x))
+    emit(section="dispatch", kind="sync_roundtrip",
+         ms=round((time.perf_counter() - t0) / reps * 1e3, 3))
+    y = x
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        y = f(y)
+    fence(y)
+    emit(section="dispatch", kind="async_chained",
+         ms=round((time.perf_counter() - t0) / reps * 1e3, 3))
+
+
+def sec_stream(reps):
+    for dt_, name, bpe in ((jnp.bfloat16, "bf16", 2), (jnp.int8, "int8", 1)):
+        L, n, k = 32, 11008, 4096
+        w = jnp.ones((L, n, k), dt_)
+        x = jnp.ones((k,), jnp.bfloat16)
+
+        def body(c, wl):
+            if dt_ == jnp.int8:
+                y = jax.lax.dot_general(wl, c.astype(jnp.int8)[:, None],
+                                        (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.int32)
+                return c, y.astype(jnp.bfloat16).sum()
+            return c, (wl @ c).sum()
+
+        g = jax.jit(lambda w, x: jax.lax.scan(body, x, w)[1].sum())
+        dt = timed(g, w, x, reps=reps)
+        gb = L * n * k * bpe / 1e9
+        emit(section="stream", dtype=name, gb=round(gb, 2), ms=round(dt * 1e3, 2),
+             gbps=round(gb / dt, 1))
+
+
+def _rand_q40(n, k, seed=0):
+    rng = np.random.RandomState(seed)
+    return QTensor.from_float((rng.randn(n, k) * 0.05).astype(np.float32),
+                              FloatType.Q40)
+
+
+def sec_matvec(reps):
+    """q4 vs q8 kernels on the 7B hot shapes, amortized over a scan of L layers."""
+    on_tpu = jax.default_backend() == "tpu"
+    shapes = [(4096, 4096), (11008, 4096), (4096, 11008), (32000, 4096)]
+    for n, k in shapes:
+        w = _rand_q40(min(n, 4096) if not on_tpu else n, k)
+        for layout in ("i4p", "i8"):
+            wl = w.to_i4p_layout() if layout == "i4p" else w.to_i8_layout()
+            wl = jax.tree_util.tree_map(jnp.asarray, wl)
+            x = jnp.ones((1, 1, k), jnp.bfloat16)
+            if layout == "i4p":
+                from distributed_llama_tpu.ops.pallas_q4 import q4_matvec as mv
+            else:
+                from distributed_llama_tpu.ops.pallas_q8 import q8_matvec as mv
+            g = jax.jit(functools.partial(mv, interpret=not on_tpu))
+            dt = timed(g, x, wl, reps=reps)
+            bytes_ = wl.data.nbytes + wl.scales.nbytes
+            emit(section="matvec", layout=layout, n=wl.shape[0], k=k,
+                 ms=round(dt * 1e3, 3), gbps=round(bytes_ / 1e9 / dt, 1))
+
+
+def sec_attention(reps):
+    """Cache read cost: full 2048-window vs 256-window at 7B geometry, per layer."""
+    from distributed_llama_tpu.ops.attention import gqa_attention
+
+    b, hq, hk, hs = 1, 32, 32, 128
+    q = jnp.ones((b, 1, hq, hs), jnp.bfloat16)
+    for s in (2048, 256):
+        kc = jnp.ones((b, hk, s, hs), jnp.bfloat16)
+        vc = jnp.ones_like(kc)
+        pos = jnp.asarray([100 % s], jnp.int32)
+        g = jax.jit(lambda q, kc, vc, p: gqa_attention(q, kc, vc, p))
+        dt = timed(g, q, kc, vc, pos, reps=reps)
+        gb = 2 * kc.nbytes / 1e9
+        emit(section="attention", window=s, ms=round(dt * 1e3, 3),
+             cache_gb=round(gb, 3), gbps=round(gb / dt, 1))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--section", default=None,
+                    choices=["dispatch", "stream", "matvec", "attention"])
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    reps = 3 if args.quick else 10
+    emit(section="meta", backend=jax.default_backend(),
+         device=str(jax.devices()[0]))
+    secs = {"dispatch": sec_dispatch, "stream": sec_stream, "matvec": sec_matvec,
+            "attention": sec_attention}
+    for name, fn in secs.items():
+        if args.section in (None, name):
+            fn(reps)
+
+
+if __name__ == "__main__":
+    main()

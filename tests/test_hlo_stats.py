@@ -130,7 +130,7 @@ def test_device_loop_stats_measure_loop_program(tp4_engine):
                                     Sampler(eng.spec.vocab_size, temperature=0.0),
                                     chunk=4)
     assert stats.traffic_source == "measured"
-    lt = eng._decode_loops[("loop", 4, "greedy")]
+    lt = eng._loop_traffics[(4, "greedy")]
     assert stats.sent_kbytes_per_token == pytest.approx(
         lt.sent_bytes_per_device / 4 / 1024.0)
     # per-token bytes of the loop program match the per-token host step

@@ -96,6 +96,35 @@ def test_prepare_for_pallas_picks_i4p_for_q40():
     assert p80["blocks"]["wq"].layout == "i8"
 
 
+def test_sharded_forward_with_i4p_params():
+    """tp=2 shard_map over grouped-i4p params (the col-sharded w2/wo carry groups=tp in
+    their pytree aux): shard_params + the jitted step must run and match the planar
+    TP step. Regression test for the groups-aux pytree mismatch."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
+                                                   make_sharded_forward, shard_params)
+
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=16,
+                     rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=3)
+    mesh = make_mesh(tp=2)
+    tokens = jnp.asarray([[1, 2, 3]])
+
+    base = shard_params(params, mesh, spec)
+    step = make_sharded_forward(spec, mesh, base, donate_cache=False)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    want, _, _ = step(base, RopeTables.create(spec), tokens, kc, vc, jnp.int32(0))
+
+    pp = shard_params(prepare_for_pallas(params, tp=2), mesh, spec)
+    assert pp["blocks"]["w2"].groups == 2
+    stepp = make_sharded_forward(spec, mesh, pp, donate_cache=False)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    got, _, _ = stepp(pp, RopeTables.create(spec), tokens, kc, vc, jnp.int32(0))
+    # prefill goes through the XLA dequant path; i4p dequant must match planar exactly
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4)
+
+
 def test_windowed_forward_equals_full():
     """attn_window >= pos+T must give EXACTLY the full-cache forward's logits — the
     positions mask already hides everything past pos, the window only trims dead reads."""
