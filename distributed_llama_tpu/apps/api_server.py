@@ -7,9 +7,11 @@ longest-prefix KV reuse (dllama-api.cpp:187-232) — reformulated over token ids
 keeps the previous conversation's KV; a new request reuses the longest common token
 prefix and rewinds `pos` instead of re-prefilling.
 
-Uses http.server (stdlib) with a generation lock — the reference is likewise a
-single-request-at-a-time accept loop (dllama-api.cpp:418-429). Batched concurrent serving
-is a capability extension tracked for a later round.
+With `--batch 1` (default) requests serialize behind a generation lock — the reference
+is likewise a single-request-at-a-time accept loop (dllama-api.cpp:418-429). With
+`--batch N` the server runs a continuous-batching scheduler (runtime/batch_engine.py):
+up to N requests decode concurrently in one batched SPMD step, a capability the
+reference lacks (its runtime has no batch dimension at all, funcs.cpp:424).
 """
 
 from __future__ import annotations
@@ -48,11 +50,13 @@ class NaiveCache:
 
 class ApiState:
     def __init__(self, engine: Engine, template_type: TemplateType,
-                 default_sampler: Sampler, device_loop_chunk: int = 0):
+                 default_sampler: Sampler, device_loop_chunk: int = 0,
+                 batch_engine=None):
         self.engine = engine
+        self.batch_engine = batch_engine  # BatchEngine when --batch > 1, else None
         self.lock = threading.Lock()
         self.cache = NaiveCache()
-        tok = engine.tokenizer
+        tok = (batch_engine or engine).tokenizer
         self.template = ChatTemplate(template_type, tok.chat_template, tok.eos_piece())
         self.default_sampler = default_sampler
         self.device_loop_chunk = device_loop_chunk
@@ -97,19 +101,21 @@ def _opt(body: dict, key: str, default):
 
 def run_completion(state: ApiState, body: dict, emit):
     """Shared completion core. `emit(text_delta)` streams; returns (text, finish)."""
-    engine, tok = state.engine, state.engine.tokenizer
+    runner = state.batch_engine or state.engine
+    tok = runner.tokenizer
+    spec = runner.spec
     messages = [ChatItem(m.get("role", "user"), m.get("content", ""))
                 for m in body.get("messages", [])]
     rendered = state.template.generate(messages)
     prompt = tok.encode(rendered, add_bos=True)
 
     sampler = Sampler(
-        engine.spec.vocab_size,
+        spec.vocab_size,
         float(_opt(body, "temperature", state.default_sampler.temperature)),
         float(_opt(body, "top_p", state.default_sampler.topp)),
         int(_opt(body, "seed", _now())),
     )
-    max_tokens = int(_opt(body, "max_tokens", 0)) or (engine.spec.seq_len - len(prompt))
+    max_tokens = int(_opt(body, "max_tokens", 0)) or (spec.seq_len - len(prompt))
 
     stops = tok.chat_stops()
     stop_param = _opt(body, "stop", [])
@@ -117,11 +123,6 @@ def run_completion(state: ApiState, body: dict, emit):
         stop_param = [stop_param]
     stops.extend(s.encode() for s in stop_param)
     detector = EosDetector(tok.chat_eos_id, stops, padding_left=2, padding_right=2)
-
-    # NaiveCache prefix reuse: rewind pos to the common token prefix
-    reuse = state.cache.resolve(prompt)
-    engine.pos = reuse
-    delta_prompt = prompt[reuse:]
 
     pieces: list[str] = []
     finish = ["length"]
@@ -132,6 +133,43 @@ def run_completion(state: ApiState, body: dict, emit):
         emit(text)
 
     streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
+
+    if state.batch_engine is not None:
+        # continuous batching: slot assignment + per-slot prefix reuse live in the
+        # BatchEngine scheduler; no server-side lock or pos bookkeeping. Socket writes
+        # are decoupled from the scheduler thread through a queue — a slow client
+        # backpressures only its own handler thread, never the shared decode loop.
+        import queue as _queue
+
+        deltas: "_queue.Queue[str | None]" = _queue.Queue()
+
+        def emit_queued(d: bytes):
+            text = d.decode("utf-8", errors="replace")
+            pieces.append(text)
+            deltas.put(text)
+
+        qstreamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t),
+                                  emit_queued)
+        req = state.batch_engine.submit(prompt, max_tokens, sampler,
+                                        on_token=qstreamer.on_token,
+                                        stop_check=qstreamer.stop_check)
+        # sentinel closes the drain loop the moment the request completes (the puts
+        # happen-before done.set(), so everything queued is drained first)
+        threading.Thread(target=lambda: (req.done.wait(), deltas.put(None)),
+                         daemon=True).start()
+        while (item := deltas.get()) is not None:
+            emit(item)
+        if req.error is not None:
+            raise req.error
+        if qstreamer.stopped:
+            finish[0] = "stop"
+        return "".join(pieces), finish[0]
+
+    engine = state.engine
+    # NaiveCache prefix reuse: rewind pos to the common token prefix
+    reuse = state.cache.resolve(prompt)
+    engine.pos = reuse
+    delta_prompt = prompt[reuse:]
 
     try:
         out, _stats = engine.generate_with(delta_prompt, max_tokens, sampler,
@@ -189,7 +227,11 @@ class Handler(BaseHTTPRequestHandler):
             return
         stream = bool(body.get("stream", False))
         state = self.state
-        with state.lock:
+        # batched mode: the scheduler serializes device access itself, so concurrent
+        # requests proceed without the server-side lock (they share decode steps)
+        import contextlib
+        guard = contextlib.nullcontext() if state.batch_engine is not None else state.lock
+        with guard:
             if stream:
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
@@ -232,10 +274,11 @@ class Handler(BaseHTTPRequestHandler):
 def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           template_type: TemplateType = TemplateType.UNKNOWN,
           default_sampler: Sampler | None = None,
-          device_loop_chunk: int = 0) -> ThreadingHTTPServer:
+          device_loop_chunk: int = 0, batch_engine=None) -> ThreadingHTTPServer:
+    runner = batch_engine or engine
     state = ApiState(engine, template_type,
-                     default_sampler or Sampler(engine.spec.vocab_size, 0.7, 0.9, 0),
-                     device_loop_chunk)
+                     default_sampler or Sampler(runner.spec.vocab_size, 0.7, 0.9, 0),
+                     device_loop_chunk, batch_engine=batch_engine)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = ThreadingHTTPServer((host, port), handler)
     print(f"🟢 dllama-api listening on {host}:{port}")
@@ -251,12 +294,40 @@ def main(argv=None) -> None:
     p = build_parser(include_mode=False)
     p.add_argument("--port", type=int, default=9990)
     p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--batch", type=int, default=1,
+                   help="continuous-batching slots: up to N requests decode "
+                        "concurrently in one batched step (1 = reference-style "
+                        "serialized serving)")
     args = p.parse_args(argv)
-    engine = make_engine(args)
-    sampler = make_sampler(args, engine.spec)
+    batch_engine = None
+    if args.batch > 1:
+        if args.sp > 1:
+            p.error("--batch > 1 requires --sp 1: per-row cache positions are "
+                    "incompatible with the sequence-sharded (ring) cache")
+        import jax.numpy as jnp
+
+        from ..runtime.batch_engine import BatchEngine
+        from .dllama import _FT
+
+        batch_engine = BatchEngine.load(
+            args.model, args.tokenizer, max_seq_len=args.max_seq_len,
+            weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
+            else None,
+            slots=args.batch, tp=args.tp,
+            dtype=(None if args.dtype == "auto"
+                   else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
+            use_pallas=False if args.no_pallas else None,
+            compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1)
+        engine = None
+        sampler = make_sampler(args, batch_engine.spec)
+        print(f"⏩ Continuous batching: {args.batch} slots")
+    else:
+        engine = make_engine(args)
+        sampler = make_sampler(args, engine.spec)
     server = serve(engine, args.host, args.port,
                    TemplateType(args.chat_template) if args.chat_template
-                   else TemplateType.UNKNOWN, sampler, args.device_loop)
+                   else TemplateType.UNKNOWN, sampler, args.device_loop,
+                   batch_engine=batch_engine)
     server.serve_forever()
 
 

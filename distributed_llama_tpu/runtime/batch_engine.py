@@ -1,0 +1,343 @@
+"""Continuous-batching engine: concurrent sequences share one batched SPMD step.
+
+The reference API server is a single-request accept loop (dllama-api.cpp:418-429) and
+its whole runtime is batch=1 (no batch dim anywhere, funcs.cpp:424). On TPU a decode
+step is HBM-bandwidth-bound — the weights stream past the MXU once per step regardless
+of how many sequences ride along — so batching B requests costs nearly the same wall
+time as one and multiplies throughput. This module is therefore a capability extension
+beyond reference parity, built on the per-row `start_pos` support in models/forward.py:
+each KV-cache row advances at its own position (continuous batching).
+
+Design:
+- B cache "slots", each holding one sequence's KV rows + host-side state.
+- One scheduler thread owns the device: it alternates chunked prefill (one slot at a
+  time — prefill briefly stalls decode, the standard continuous-batching trade) with
+  batched T=1 decode steps for every active slot.
+- Idle rows ride along with their start_pos parked at their current position: their
+  cache writes land at future positions that are masked now and overwritten when those
+  positions actually decode, so no masking program is needed.
+- Sampling/EOS stay on the host per row (reference Sampler semantics).
+- Per-slot NaiveCache prefix reuse (dllama-api.cpp:187-232): a new request lands on the
+  free slot sharing the longest token prefix and rewinds instead of re-prefilling.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.spec import ModelSpec
+from .engine import PREFILL_CHUNKS, GenerationStats
+
+__all__ = ["BatchEngine", "BatchRequest"]
+
+
+@dataclass
+class BatchRequest:
+    prompt: list[int]
+    max_tokens: int
+    sampler: object
+    on_token: Callable[[int], None] | None = None
+    stop_check: Callable[[int], bool] | None = None
+    # results
+    out: list[int] = field(default_factory=list)
+    finish: str = "length"
+    error: Exception | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+    stats: GenerationStats = field(default_factory=GenerationStats)
+
+    def wait(self, timeout=None) -> list[int]:
+        self.done.wait(timeout)
+        if self.error is not None:
+            raise self.error
+        return self.out
+
+
+class _Slot:
+    def __init__(self, index: int):
+        self.index = index
+        self.pos = 0  # next cache position for this row
+        self.history: list[int] = []  # tokens whose KV is written (prefix reuse)
+        self.req: BatchRequest | None = None
+        self.pending: list[int] = []  # prompt tokens not yet prefilled
+        self.last_token = 0  # feeds the next decode step
+        self.last_logits: np.ndarray | None = None
+
+
+class BatchEngine:
+    """Engine-compatible construction (same spec/params arguments), `slots` sequences.
+
+    Use submit() for async operation or generate() for the Engine-compatible blocking
+    call. The scheduler thread starts lazily on first submit and can be stopped with
+    close().
+    """
+
+    def __init__(self, spec: ModelSpec, params, tokenizer=None, *, slots: int = 2,
+                 **engine_kw):
+        from .engine import Engine
+
+        assert slots >= 1
+        assert engine_kw.get("sp", 1) in (None, 1), (
+            "continuous batching needs per-row cache positions, which the "
+            "sequence-sharded (ring) cache does not support")
+        self.slots_n = slots
+        self._eng = Engine(spec, params, tokenizer, batch=slots, **engine_kw)
+        self.spec = spec
+        self.tokenizer = tokenizer
+        self._slots = [_Slot(i) for i in range(slots)]
+        self._queue: "queue.Queue[BatchRequest]" = queue.Queue()
+        self.prefilled_tokens = 0  # observability: total tokens run through prefill
+        self._wake = threading.Event()
+        self._shutdown = False
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def load(cls, model_path: str, tokenizer_path: str | None = None, *,
+             max_seq_len: int = 0, weights_ftype=None, slots: int = 2,
+             **kw) -> "BatchEngine":
+        """Engine.load-compatible constructor (same flag surface, same vocab check)."""
+        from ..formats.mfile import load_model
+        from ..tokenizer.bpe import Tokenizer
+
+        spec, params = load_model(model_path, max_seq_len, weights_ftype)
+        tokenizer = Tokenizer.load(tokenizer_path) if tokenizer_path else None
+        if tokenizer is not None and tokenizer.vocab_size != spec.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {tokenizer.vocab_size} != model vocab {spec.vocab_size}")
+        return cls(spec, params, tokenizer, slots=slots, **kw)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_tokens: int, sampler,
+               on_token=None, stop_check=None) -> BatchRequest:
+        if self._shutdown:
+            raise RuntimeError("BatchEngine is closed")
+        req = BatchRequest(list(prompt), max_tokens, sampler, on_token, stop_check)
+        if not req.prompt:
+            req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
+        self._ensure_thread()
+        self._queue.put(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt: list[int], max_tokens: int, sampler,
+                 on_token=None, stop_check=None) -> tuple[list[int], GenerationStats]:
+        """Blocking Engine.generate-compatible call (rides the batched scheduler)."""
+        req = self.submit(prompt, max_tokens, sampler, on_token, stop_check)
+        out = req.wait()
+        return out, req.stats
+
+    def close(self) -> None:
+        self._shutdown = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        # unblock every waiter: in-flight slots and still-queued requests
+        err = RuntimeError("BatchEngine closed")
+        for s in self._slots:
+            if s.req is not None:
+                s.req.error = err
+                self._finish(s, "error")
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = err
+            req.done.set()
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._loop, daemon=True,
+                                                name="batch-engine")
+                self._thread.start()
+
+    def _assign(self, req: BatchRequest) -> _Slot | None:
+        """Place a request on the free slot with the longest common token prefix
+        (the multi-slot generalization of the reference NaiveCache)."""
+        free = [s for s in self._slots if s.req is None]
+        if not free:
+            return None
+        def common(s: _Slot) -> int:
+            n = 0
+            for a, b in zip(s.history, req.prompt):
+                if a != b:
+                    break
+                n += 1
+            return min(n, len(req.prompt) - 1)
+        best = max(free, key=common)
+        reuse = common(best)
+        best.req = req
+        best.pos = reuse
+        best.history = best.history[:reuse]
+        best.pending = req.prompt[reuse:]
+        best.last_logits = None
+        req.stats.prompt_tokens = len(req.prompt)
+        return best
+
+    def _step(self, tokens_rows: list[list[int]], starts: list[int], t: int):
+        """Run one batched (B, t) step; returns logits (B, t, vocab) np.ndarray."""
+        eng = self._eng
+        window = eng._window_for(max(s + t for s in starts))
+        step = eng._step_for(window)
+        toks = jnp.asarray(np.asarray(tokens_rows, dtype=np.int32))
+        start_pos = jnp.asarray(np.asarray(starts, dtype=np.int32))
+        logits, eng.k_cache, eng.v_cache = step(
+            eng.params, eng.rope, toks, eng.k_cache, eng.v_cache, start_pos)
+        return np.asarray(logits)
+
+    def _finish(self, slot: _Slot, finish: str) -> None:
+        req = slot.req
+        req.finish = finish
+        slot.req = None
+        slot.pending = []
+        req.done.set()
+
+    def _park_positions(self, t: int) -> list[int]:
+        """Per-row start positions for rows not participating in this step: park at the
+        row's current pos so garbage lands on masked future positions, clamped so the
+        write stays inside the cache. A clamped park (row sitting within t of the end)
+        overwrites that row's tail history, so the reusable prefix is truncated to the
+        write start."""
+        s = self.spec.seq_len
+        starts = []
+        for sl in self._slots:
+            p = min(sl.pos, max(s - t, 0))
+            if p < sl.pos:
+                sl.history = sl.history[:p]
+            starts.append(p)
+        return starts
+
+    def _loop(self) -> None:
+        import time
+
+        while not self._shutdown:
+            # admit queued requests onto free slots
+            try:
+                while True:
+                    req = self._queue.get_nowait()
+                    if self._assign(req) is None:
+                        # no free slot: push back and serve current load first
+                        requeue = req
+                        self._queue.queue.appendleft(requeue)  # type: ignore[attr-defined]
+                        break
+            except queue.Empty:
+                pass
+
+            prefill = [s for s in self._slots if s.req and s.pending]
+            active = [s for s in self._slots if s.req and not s.pending]
+            try:
+                if prefill:
+                    self._prefill_step(prefill[0])
+                elif active:
+                    self._decode_step(active)
+                else:
+                    self._wake.wait(timeout=0.2)
+                    self._wake.clear()
+            except Exception as e:  # propagate to every in-flight request
+                for s in self._slots:
+                    if s.req is not None:
+                        s.req.error = e
+                        self._finish(s, "error")
+                time.sleep(0.01)
+
+    def _prefill_step(self, slot: _Slot) -> None:
+        import time
+
+        t0 = time.perf_counter()
+        s = self.spec.seq_len
+        room = s - slot.pos
+        if room <= 0:
+            slot.last_logits = None
+            slot.pending = []
+            return
+        chunk = next((c for c in PREFILL_CHUNKS if len(slot.pending) >= c), 1)
+        chunk = min(chunk, room)
+        # keep parked rows' scratch writes inside the cache without touching history:
+        # a parked row writes [pos, pos+chunk) which must fit under seq_len; shrink the
+        # chunk when any OTHER row sits too close to the end (its history would be
+        # corrupted by a clamped write below its pos)
+        for other in self._slots:
+            if other is not slot and other.req is not None:
+                chunk = min(chunk, max(s - other.pos, 1))
+        piece = slot.pending[:chunk]
+        starts = self._park_positions(len(piece))
+        starts[slot.index] = slot.pos
+        rows = [[tok for tok in ([0] * len(piece))] for _ in self._slots]
+        rows[slot.index] = piece
+        logits = self._step(rows, starts, len(piece))
+        self.prefilled_tokens += len(piece)
+        slot.pos += len(piece)
+        slot.history.extend(piece)
+        slot.pending = slot.pending[len(piece):]
+        if not slot.pending:
+            slot.last_logits = logits[slot.index, -1]
+            slot.last_token = slot.history[-1]
+        slot.req.stats.prefill_ms += (time.perf_counter() - t0) * 1000.0
+
+    def _decode_step(self, active: list[_Slot]) -> None:
+        import time
+
+        # sample the next token for every active row from its last logits
+        for slot in active[:]:
+            req = slot.req
+            if slot.last_logits is None:  # context end hit during prefill
+                self._finish(slot, "length")
+                active.remove(slot)
+                continue
+            if req.max_tokens <= 0:  # parity with Engine.generate: zero-token request
+                self._finish(slot, "length")
+                active.remove(slot)
+                continue
+            try:
+                token = req.sampler.sample(slot.last_logits)
+                req.out.append(token)
+                req.stats.generated_tokens += 1
+                if req.on_token is not None:
+                    req.on_token(token)
+                stopped = req.stop_check is not None and req.stop_check(token)
+            except Exception as e:
+                # a broken callback (e.g. client disconnect mid-stream) fails ONLY
+                # this request; the other slots keep decoding
+                req.error = e
+                self._finish(slot, "error")
+                active.remove(slot)
+                continue
+            if stopped:
+                self._finish(slot, "stop")
+                active.remove(slot)
+                continue
+            if len(req.out) >= req.max_tokens or slot.pos >= self.spec.seq_len:
+                self._finish(slot, "length")
+                active.remove(slot)
+                continue
+            slot.last_token = token
+        if not active:
+            return
+        t0 = time.perf_counter()
+        starts = self._park_positions(1)
+        rows = [[0]] * self.slots_n
+        for slot in active:
+            starts[slot.index] = slot.pos
+            rows[slot.index] = [slot.last_token]
+        logits = self._step(rows, starts, 1)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        for slot in active:
+            slot.last_logits = logits[slot.index, -1]
+            slot.history.append(slot.last_token)
+            slot.pos += 1
+            slot.req.stats.token_ms.append(dt_ms)
+            slot.req.stats.infer_ms.append(dt_ms)

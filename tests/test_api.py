@@ -137,3 +137,87 @@ def test_stop_sequence_override(server):
     data = json.loads(r.read())
     content = data["choices"][0]["message"]["content"]
     assert "e" not in content
+
+
+@pytest.fixture(scope="module")
+def batched_server(tmp_path_factory):
+    """Server in continuous-batching mode (--batch 2): concurrent requests share steps."""
+    from distributed_llama_tpu.formats.mfile import load_model
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.tokenizer.bpe import Tokenizer
+
+    tmp = tmp_path_factory.mktemp("api_batched")
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=262, seq_len=128).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=21)
+    mpath = str(tmp / "m.m")
+    write_model(mpath, spec, params_file_order(spec, params), FloatType.F32)
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)] + \
+        [b"<|im_start|>", b"<|im_end|>", b" "]
+    scores = [0.0] * 259 + [-1.0, -1.0, -1.5]
+    tpath = str(tmp / "t.t")
+    write_tokenizer(tpath, TokenizerData(
+        vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=260,
+        max_token_length=12, chat_template="{{<|im_start|>}}"))
+
+    lspec, lparams = load_model(mpath, 0)
+    be = BatchEngine(lspec, lparams, Tokenizer.load(tpath), slots=2, tp=1)
+    srv = serve(None, host="127.0.0.1", port=0, template_type=TemplateType.CHATML,
+                batch_engine=be)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield port
+    srv.shutdown()
+    be.close()
+
+
+def test_batched_concurrent_requests(batched_server):
+    """Two concurrent clients must both get valid completions, and their generation
+    must overlap in time (no serialization behind a server lock)."""
+    import time
+
+    body = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 16, "temperature": 0, "seed": 5}
+    # warm all compiled shapes once
+    assert _post(batched_server, "/v1/chat/completions", dict(body)).status == 200
+
+    results = {}
+    spans = {}
+
+    def client(i):
+        t0 = time.perf_counter()
+        r = _post(batched_server, "/v1/chat/completions",
+                  dict(body, messages=[{"role": "user", "content": f"hello {i}"}]))
+        assert r.status == 200
+        results[i] = json.loads(r.read())
+        spans[i] = (t0, time.perf_counter())
+
+    def run_both():
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        return time.perf_counter() - t0
+
+    run_both()  # warm every compiled shape both request lengths touch
+    t_both = run_both()
+
+    for i in range(2):
+        choice = results[i]["choices"][0]
+        assert choice["message"]["content"] is not None
+        assert choice["finish_reason"] in ("length", "stop")
+    # concurrency: the two requests' service windows overlapped
+    overlap = min(spans[0][1], spans[1][1]) - max(spans[0][0], spans[1][0])
+    assert overlap > 0, spans
+
+    # throughput sanity at the HTTP level: both together well under 2x a single
+    # request (the tight >1.5x throughput assertion lives in the engine-level test
+    # tests/test_batch_engine.py::test_two_concurrent_beat_single_throughput, where
+    # timing is not subject to HTTP/thread scheduling noise)
+    t0 = time.perf_counter()
+    client("solo")
+    t_solo = time.perf_counter() - t0
+    assert t_both < 1.9 * t_solo, (t_both, t_solo)

@@ -1,0 +1,113 @@
+"""Continuous-batching engine tests (8-device CPU mesh via conftest).
+
+The batched scheduler must (a) reproduce the single-sequence Engine's greedy tokens
+exactly for every concurrent request, (b) actually give batching's throughput win —
+2 concurrent clients > 1.5x one client's token rate (the reference serializes requests,
+dllama-api.cpp:418-429, so any ratio > 1 is already beyond parity), and (c) reuse KV
+prefixes across requests on the same slot (the NaiveCache generalization).
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+from distributed_llama_tpu.runtime.engine import Engine
+from distributed_llama_tpu.runtime.sampler import Sampler
+
+
+def _spec(seq_len=128):
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=4, vocab_size=256, seq_len=seq_len,
+                     rope_type=RopeType.LLAMA).resolved()
+
+
+@pytest.fixture(scope="module")
+def setup():
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    be = BatchEngine(spec, params, slots=2, tp=2)
+    yield spec, params, be
+    be.close()
+
+
+def test_batched_matches_single_engine(setup):
+    spec, params, be = setup
+    eng = Engine(spec, params, tp=2)
+    prompts = [[1, 7, 23, 5], [1, 9, 2]]
+    wants = []
+    for p in prompts:
+        eng.reset()
+        out, _ = eng.generate(list(p), 10, Sampler(spec.vocab_size, temperature=0.0))
+        wants.append(out)
+
+    reqs = [be.submit(list(p), 10, Sampler(spec.vocab_size, temperature=0.0))
+            for p in prompts]
+    outs = [r.wait(timeout=120) for r in reqs]
+    assert outs == wants
+    for r in reqs:
+        assert r.finish == "length"
+        assert r.stats.generated_tokens == 10
+
+
+def test_two_concurrent_beat_single_throughput(setup):
+    """2 concurrent requests must finish in well under 2x one request's time (they
+    share each decode step). Target from the round-3 verdict: >1.5x throughput."""
+    spec, params, be = setup
+    n = 24
+    sampler = lambda: Sampler(spec.vocab_size, temperature=0.0)
+    prompt = [1, 4, 9]
+
+    be.generate(list(prompt), n, sampler())  # warm every compiled shape
+    t0 = time.perf_counter()
+    be.generate(list(prompt), n, sampler())
+    t_single = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    reqs = [be.submit([1, 4, 9 + i], n, sampler()) for i in range(2)]
+    for r in reqs:
+        r.wait(timeout=120)
+    t_conc = time.perf_counter() - t0
+
+    throughput_ratio = 2 * t_single / t_conc
+    assert throughput_ratio > 1.5, (t_single, t_conc, throughput_ratio)
+
+
+def test_slot_prefix_reuse(setup):
+    spec, params, be = setup
+    prompt = [1, 5, 6, 7, 8, 9, 10, 11]
+    out1 = be.submit(list(prompt), 4, Sampler(spec.vocab_size, temperature=0.0)).wait(120)
+    base = be.prefilled_tokens
+    # identical prompt again: everything but the final token should come from the slot
+    out2 = be.submit(list(prompt), 4, Sampler(spec.vocab_size, temperature=0.0)).wait(120)
+    assert out2 == out1
+    assert be.prefilled_tokens - base <= 1
+
+
+def test_max_tokens_and_stop_check(setup):
+    spec, params, be = setup
+    sampler = Sampler(spec.vocab_size, temperature=0.0)
+    full = be.submit([1, 2, 3], 12, Sampler(spec.vocab_size, temperature=0.0)).wait(120)
+    stop_at = full[2]
+    req = be.submit([1, 2, 3], 12, sampler, stop_check=lambda t: t == stop_at)
+    out = req.wait(120)
+    assert out == full[:3]
+    assert req.finish == "stop"
+
+
+def test_context_end_finishes_length():
+    spec = _spec(seq_len=16)
+    params = init_random_params(spec, FloatType.Q40, seed=3)
+    be = BatchEngine(spec, params, slots=2, tp=1)
+    try:
+        req = be.submit([1, 2, 3, 4], 100, Sampler(spec.vocab_size, temperature=0.0))
+        out = req.wait(timeout=120)
+        assert req.finish == "length"
+        # pos never exceeds seq_len; tokens generated till the cache filled
+        assert 0 < len(out) <= 16
+    finally:
+        be.close()
