@@ -127,13 +127,6 @@ def run_completion(state: ApiState, body: dict, emit):
     pieces: list[str] = []
     finish = ["length"]
 
-    def emit_bytes(d: bytes):
-        text = d.decode("utf-8", errors="replace")
-        pieces.append(text)
-        emit(text)
-
-    streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
-
     if state.batch_engine is not None:
         # continuous batching: slot assignment + per-slot prefix reuse live in the
         # BatchEngine scheduler; no server-side lock or pos bookkeeping. Socket writes
@@ -157,8 +150,14 @@ def run_completion(state: ApiState, body: dict, emit):
         # happen-before done.set(), so everything queued is drained first)
         threading.Thread(target=lambda: (req.done.wait(), deltas.put(None)),
                          daemon=True).start()
-        while (item := deltas.get()) is not None:
-            emit(item)
+        try:
+            while (item := deltas.get()) is not None:
+                emit(item)
+        except Exception:
+            # client went away mid-stream: free the slot instead of decoding the
+            # abandoned request to max_tokens
+            req.cancel()
+            raise
         if req.error is not None:
             raise req.error
         if qstreamer.stopped:
@@ -166,6 +165,13 @@ def run_completion(state: ApiState, body: dict, emit):
         return "".join(pieces), finish[0]
 
     engine = state.engine
+
+    def emit_bytes(d: bytes):
+        text = d.decode("utf-8", errors="replace")
+        pieces.append(text)
+        emit(text)
+
+    streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
     # NaiveCache prefix reuse: rewind pos to the common token prefix
     reuse = state.cache.resolve(prompt)
     engine.pos = reuse

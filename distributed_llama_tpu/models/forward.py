@@ -169,7 +169,23 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
     top_p, top_i = jax.lax.top_k(probs, k)  # (B, T, K)
     weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)  # renormalize (grokMoeNormWeights)
 
-    if b * t * k <= spec.n_experts:
+    if use_pallas and b * t == 1 and bp["moe_up"].layout in ("i4p", "i8"):
+        # Decode through the fused matvec kernels: dynamic_slice each active expert's
+        # packed planes out of the stacked (E, ...) QTensor (moving exactly that
+        # expert's bytes through HBM — the reference's per-active-expert matmuls,
+        # grok1-tasks.cpp:128-144) and run the same q4/q8 kernel as the dense path.
+        def expert_q(wstack, e):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, e, 1, 0)[0], wstack)
+
+        out = jnp.zeros_like(xb)
+        for j in range(k):
+            e = top_i.reshape(k)[j]
+            hb = qmatmul(xb, expert_q(bp["moe_up"], e), use_pallas=True) * act(
+                qmatmul(xb, expert_q(bp["moe_gate"], e), use_pallas=True))
+            out_e = qmatmul(hb, expert_q(bp["moe_down"], e), use_pallas=True)
+            out = out + out_e * weights.reshape(k)[j].astype(xb.dtype)
+    elif b * t * k <= spec.n_experts:
         # Decode: gather the K active experts' (sliced) weight matrices per token,
         # dequantize, matmul. Moves exactly the active experts' bytes out of HBM — the
         # same bandwidth shape as the reference's per-expert forward calls.

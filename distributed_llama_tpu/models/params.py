@@ -86,13 +86,14 @@ def init_random_params(spec: ModelSpec, weights_ftype: FloatType = FloatType.F32
 
 _I8_CONVERTIBLE = (FloatType.Q40, FloatType.Q80)
 
-# per-layer tensors whose scan-sliced form is the 2-D matvec the decode kernels consume.
-# MoE expert stacks (3-D per layer) and the router (use_pallas=False in forward) stay
-# planar: the kernel can't take them, and expanded layouts would grow their HBM for
-# nothing. Tensors in _COL_SHARDED get their in-axis TP-sliced (ColMatmulSlice), so the
-# i4p split-plane pack must be applied per column group (QTensor.to_i4p_layout).
-_DENSE_MATMULS = {"wq", "wk", "wv", "wo", "w1", "w2", "w3"}
-_COL_SHARDED = {"wo", "w2"}
+# per-layer tensors whose scan-sliced (and, for MoE stacks, expert-sliced) form is the
+# 2-D matvec the decode kernels consume. The router stays planar (use_pallas=False in
+# forward — it is tiny). Tensors in _COL_SHARDED get their in-axis TP-sliced
+# (ColMatmulSlice), so the i4p split-plane pack must be applied per column group
+# (QTensor.to_i4p_layout).
+_DENSE_MATMULS = {"wq", "wk", "wv", "wo", "w1", "w2", "w3",
+                  "moe_up", "moe_gate", "moe_down"}
+_COL_SHARDED = {"wo", "w2", "moe_down"}
 
 
 def _kernel_convertible(t: QTensor, stacked: bool) -> bool:
@@ -101,6 +102,8 @@ def _kernel_convertible(t: QTensor, stacked: bool) -> bool:
     if not (isinstance(t, QTensor) and t.ftype in _I8_CONVERTIBLE):
         return False
     shape = t.shape[1:] if stacked else t.shape
+    if len(shape) == 3:  # MoE expert stack (E, out, in): kernel sees one expert slice
+        shape = shape[1:]
     return len(shape) == 2 and q8_shape_supported(*shape)
 
 

@@ -51,8 +51,15 @@ class BatchRequest:
     done: threading.Event = field(default_factory=threading.Event)
     stats: GenerationStats = field(default_factory=GenerationStats)
 
+    cancelled: bool = False
+
+    def cancel(self) -> None:
+        """Ask the scheduler to stop decoding this request (client went away)."""
+        self.cancelled = True
+
     def wait(self, timeout=None) -> list[int]:
-        self.done.wait(timeout)
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"generation not finished within {timeout}s")
         if self.error is not None:
             raise self.error
         return self.out
@@ -91,6 +98,7 @@ class BatchEngine:
         self.tokenizer = tokenizer
         self._slots = [_Slot(i) for i in range(slots)]
         self._queue: "queue.Queue[BatchRequest]" = queue.Queue()
+        self._pending: list[BatchRequest] = []  # scheduler-local overflow (no free slot)
         self.prefilled_tokens = 0  # observability: total tokens run through prefill
         self._wake = threading.Event()
         self._shutdown = False
@@ -148,11 +156,13 @@ class BatchEngine:
                 self._finish(s, "error")
         while True:
             try:
-                req = self._queue.get_nowait()
+                self._pending.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        for req in self._pending:
             req.error = err
             req.done.set()
+        self._pending.clear()
 
     # ------------------------------------------------------------------
     # scheduler
@@ -225,17 +235,22 @@ class BatchEngine:
         import time
 
         while not self._shutdown:
-            # admit queued requests onto free slots
-            try:
-                while True:
-                    req = self._queue.get_nowait()
-                    if self._assign(req) is None:
-                        # no free slot: push back and serve current load first
-                        requeue = req
-                        self._queue.queue.appendleft(requeue)  # type: ignore[attr-defined]
-                        break
-            except queue.Empty:
-                pass
+            # admit queued requests onto free slots (FIFO: scheduler-local overflow
+            # first, then the cross-thread queue)
+            while True:
+                try:
+                    self._pending.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            while self._pending:
+                if self._pending[0].cancelled:
+                    req = self._pending.pop(0)
+                    req.finish = "cancelled"
+                    req.done.set()
+                    continue
+                if self._assign(self._pending[0]) is None:
+                    break  # no free slot: serve current load first
+                self._pending.pop(0)
 
             prefill = [s for s in self._slots if s.req and s.pending]
             active = [s for s in self._slots if s.req and not s.pending]
@@ -294,6 +309,10 @@ class BatchEngine:
         # sample the next token for every active row from its last logits
         for slot in active[:]:
             req = slot.req
+            if req.cancelled:
+                self._finish(slot, "cancelled")
+                active.remove(slot)
+                continue
             if slot.last_logits is None:  # context end hit during prefill
                 self._finish(slot, "length")
                 active.remove(slot)

@@ -125,6 +125,29 @@ def test_sharded_forward_with_i4p_params():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4)
 
 
+def test_moe_decode_kernel_path_matches_planar():
+    """Mixtral decode with i4p expert stacks (the kernel path slices each active
+    expert's packed planes with dynamic_slice) must match the planar gather path at
+    Q80 activation-quantization error scale."""
+    spec = ModelSpec(arch_type=ArchType.MIXTRAL, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=16,
+                     n_experts=4, n_active_experts=2,
+                     rope_type=RopeType.FALCON).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=13)
+    rope = RopeTables.create(spec)
+    pp = prepare_for_pallas(params)
+    assert pp["blocks"]["moe_up"].layout == "i4p"
+
+    tok = jnp.asarray([[5]])
+    kc, vc = init_kv_cache(spec)
+    want, _, _ = forward(params, spec, rope, tok, kc, vc, jnp.int32(0))
+    kc, vc = init_kv_cache(spec)
+    got, _, _ = forward(pp, spec, rope, tok, kc, vc, jnp.int32(0), use_pallas=True)
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 0.03, rel
+
+
 def test_windowed_forward_equals_full():
     """attn_window >= pos+T must give EXACTLY the full-cache forward's logits — the
     positions mask already hides everything past pos, the window only trims dead reads."""
