@@ -98,7 +98,10 @@ class BatchEngine:
         self.tokenizer = tokenizer
         self._slots = [_Slot(i) for i in range(slots)]
         self._queue: "queue.Queue[BatchRequest]" = queue.Queue()
-        self._pending: list[BatchRequest] = []  # scheduler-local overflow (no free slot)
+        # overflow requests with no free slot; guarded by _plock (close() may run while
+        # the scheduler thread is still finishing a long device step)
+        self._pending: list[BatchRequest] = []
+        self._plock = threading.Lock()
         self.prefilled_tokens = 0  # observability: total tokens run through prefill
         self._wake = threading.Event()
         self._shutdown = False
@@ -154,15 +157,16 @@ class BatchEngine:
             if s.req is not None:
                 s.req.error = err
                 self._finish(s, "error")
-        while True:
-            try:
-                self._pending.append(self._queue.get_nowait())
-            except queue.Empty:
-                break
-        for req in self._pending:
-            req.error = err
-            req.done.set()
-        self._pending.clear()
+        with self._plock:
+            while True:
+                try:
+                    self._pending.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            for req in self._pending:
+                req.error = err
+                req.done.set()
+            self._pending.clear()
 
     # ------------------------------------------------------------------
     # scheduler
@@ -237,21 +241,25 @@ class BatchEngine:
         while not self._shutdown:
             # admit queued requests onto free slots (FIFO: scheduler-local overflow
             # first, then the cross-thread queue)
-            while True:
-                try:
-                    self._pending.append(self._queue.get_nowait())
-                except queue.Empty:
-                    break
-            while self._pending:
-                if self._pending[0].cancelled:
-                    req = self._pending.pop(0)
-                    req.finish = "cancelled"
-                    req.done.set()
-                    continue
-                if self._assign(self._pending[0]) is None:
-                    break  # no free slot: serve current load first
-                self._pending.pop(0)
+            with self._plock:
+                while True:
+                    try:
+                        self._pending.append(self._queue.get_nowait())
+                    except queue.Empty:
+                        break
+                while self._pending:
+                    if self._pending[0].cancelled:
+                        req = self._pending.pop(0)
+                        req.finish = "cancelled"
+                        req.done.set()
+                        continue
+                    if self._assign(self._pending[0]) is None:
+                        break  # no free slot: serve current load first
+                    self._pending.pop(0)
 
+            for sl in self._slots:  # a cancelled request frees its slot immediately,
+                if sl.req is not None and sl.req.cancelled:  # even mid-prefill
+                    self._finish(sl, "cancelled")
             prefill = [s for s in self._slots if s.req and s.pending]
             active = [s for s in self._slots if s.req and not s.pending]
             try:
