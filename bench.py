@@ -135,7 +135,7 @@ def vs_baseline(args, tok_s: float):
     """Ratio vs the reference's published number — which exists only for the
     Llama-2-7B single-node config (README.md:131). Other archs report null rather
     than a ratio against the wrong model's baseline."""
-    if args.arch == "llama2_7b" or args.small:
+    if args.arch == "llama2_7b" and not args.small:
         return round(tok_s / BASELINE_TOK_S, 3)
     return None
 
@@ -193,6 +193,9 @@ def main():
         # reference prefills strictly token-by-token, dllama.cpp:163-167; chunked
         # prefill is a claimed capability win — this measures it)
         t_chunk = args.prefill
+        if t_chunk > spec.seq_len // 2:
+            ap.error(f"--prefill {t_chunk} too large: compile + timed chunks must "
+                     f"fit seq_len {spec.seq_len}")
         # compile chunk + n_disp timed chunks must fit the context
         n_disp = max(min(args.steps, spec.seq_len // t_chunk - 1), 1)
         pwindow = 1 << max((t_chunk * (n_disp + 1)).bit_length(), 8)

@@ -151,13 +151,18 @@ class BatchEngine:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
-        # unblock every waiter: in-flight slots and still-queued requests
+        # unblock every waiter: in-flight slots and still-queued requests. The
+        # scheduler may still be alive after the join timeout (long device step), so
+        # snapshot each slot's request and tolerate it finishing concurrently.
         err = RuntimeError("BatchEngine closed")
-        for s in self._slots:
-            if s.req is not None:
-                s.req.error = err
-                self._finish(s, "error")
         with self._plock:
+            for s in self._slots:
+                req = s.req
+                if req is not None:
+                    req.error = err
+                    s.req = None
+                    s.pending = []
+                    req.done.set()
             while True:
                 try:
                     self._pending.append(self._queue.get_nowait())

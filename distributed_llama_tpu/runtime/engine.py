@@ -183,6 +183,26 @@ class Engine:
                 closed, dict(self.mesh.shape))
         return self._measured_traffic
 
+    def compiled_collective_stats(self):
+        """Collective traffic read from the XLA-OPTIMIZED module of the T=1 step —
+        the cross-check for collective_stats(): the jaxpr accounting predicts what
+        was traced; this sees what XLA actually lowered (all-reduce rewrites,
+        combining, async pairs). Semantics differ on loops: the jaxpr walker
+        multiplies scan-body collectives by the trip count, while this counts HLO
+        instructions once (the layer scan compiles to a while loop), so per-layer
+        collectives appear once here — compare per-instruction kinds/payloads, not
+        totals. Costs a full compile on first call (memoized after)."""
+        if getattr(self, "_compiled_traffic", None) is not None:
+            return self._compiled_traffic
+        from ..parallel.hlo_stats import collective_traffic
+
+        tokens = jnp.zeros((self.batch, 1), jnp.int32)
+        lowered = jax.jit(self._step).lower(
+            self.params, self.rope, tokens, self.k_cache, self.v_cache, jnp.int32(0))
+        hlo = lowered.compile().as_text()
+        self._compiled_traffic = collective_traffic(hlo, self.tp * self.sp)
+        return self._compiled_traffic
+
     def _fill_traffic(self, stats: GenerationStats, measured=None,
                       per_tokens: int = 1) -> None:
         """Per-token S/R from `measured` (a CollectiveTraffic for a program covering

@@ -150,3 +150,19 @@ def test_modeled_traffic_labeled():
     _, stats = eng.generate([1], 2, Sampler(spec.vocab_size, temperature=0.0))
     assert stats.traffic_source == "modeled"
     assert stats.sent_kbytes_per_token > 0
+
+
+def test_compiled_hlo_cross_check(tp4_engine):
+    """The optimized-HLO parser must see the same collective KINDS the jaxpr
+    accounting predicts (counts differ by loop semantics: the jaxpr walker
+    multiplies scan bodies by trip count, the HLO text counts instructions)."""
+    eng = tp4_engine
+    jx = eng.collective_stats()
+    hl = eng.compiled_collective_stats()
+    assert set(hl.counts), "optimized module shows no collectives at tp=4"
+    # every lowered collective kind is one the jaxpr model knows about, and the
+    # logits all-gather (outside any loop) appears in both with identical count
+    assert set(hl.counts) <= set(jx.counts) | {"all-reduce"}
+    assert "all-gather" in hl.counts and "all-gather" in jx.counts
+    assert hl.counts["all-gather"] == jx.counts["all-gather"]
+    assert hl.sent_bytes_per_device > 0

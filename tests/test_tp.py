@@ -143,3 +143,58 @@ def test_kv_cache_stays_sharded():
     # cache sharding: heads axis split over tp
     shard_shape = kc2.sharding.shard_shape(kc2.shape)
     assert shard_shape[2] == spec.n_kv_heads // 4
+
+
+def test_llama31_405b_spec_shards_at_full_scale():
+    """The real Llama-3.1-405B geometry (126 layers, dim 16384, 8 KV heads) must
+    TRACE through the full sharded step on a tp=8 mesh — shape/sharding validation
+    via jax.eval_shape with zero weight memory. This is the pod-scale config the
+    reference gates at nSlices <= nKvHeads (transformer.cpp:108-111) and the
+    SURVEY §7 build-plan step 8 target."""
+    import jax
+    from distributed_llama_tpu.models.params import block_tensor_shapes
+    from distributed_llama_tpu.models.spec import RopeType as RT
+    from distributed_llama_tpu.quants import QK, FloatType, QTensor
+
+    spec = ModelSpec(
+        arch_type=ArchType.LLAMA, dim=16384, hidden_dim=53248, n_layers=126,
+        n_heads=128, n_kv_heads=8, vocab_size=128256, seq_len=2048,
+        rope_theta=500000.0, rope_type=RT.LLAMA3_1, rope_scaling_factor=8.0,
+        rope_scaling_low_freq_factor=1.0, rope_scaling_high_freq_factor=4.0,
+        rope_scaling_orig_max_seq_len=8192).resolved()
+
+    def q40_struct(shape):
+        out, in_ = shape[-2], shape[-1]
+        lead = shape[:-2]
+        return QTensor(
+            FloatType.Q40,
+            jax.ShapeDtypeStruct((*lead, out, in_ // QK, 16), jnp.uint8),
+            jax.ShapeDtypeStruct((*lead, out, in_ // QK), jnp.float16))
+
+    blocks = {}
+    for name, (shape, quantized) in block_tensor_shapes(spec).items():
+        full = (spec.n_layers, *shape)
+        blocks[name] = (q40_struct(full) if quantized
+                        else jax.ShapeDtypeStruct(full, jnp.float32))
+    params = {
+        "embedding": jax.ShapeDtypeStruct((spec.vocab_size, spec.dim), jnp.float32),
+        "blocks": blocks,
+        "rms_final": jax.ShapeDtypeStruct((spec.dim,), jnp.float32),
+        "wcls": q40_struct((spec.vocab_size, spec.dim)),
+    }
+
+    mesh = make_mesh(tp=8)
+    rope_shape = RopeTables.create(  # real tables are small; build them for real
+        ModelSpec(**{**spec.__dict__}).resolved())
+    from distributed_llama_tpu.parallel.sharding import effective_kv_heads
+    hk = effective_kv_heads(spec, 8)
+    cache = jax.ShapeDtypeStruct(
+        (spec.n_layers, 1, hk, spec.seq_len, spec.head_size), jnp.bfloat16)
+    step = make_sharded_forward(spec, mesh, params, dtype=jnp.bfloat16,
+                                donate_cache=False, attn_window=256)
+    out = jax.eval_shape(step, params, rope_shape,
+                         jax.ShapeDtypeStruct((1, 1), jnp.int32), cache, cache,
+                         jax.ShapeDtypeStruct((), jnp.int32))
+    logits, kc, vc = out
+    assert logits.shape == (1, 1, spec.vocab_size)
+    assert kc.shape == cache.shape
