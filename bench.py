@@ -198,7 +198,7 @@ def main():
                      f"fit seq_len {spec.seq_len}")
         # compile chunk + n_disp timed chunks must fit the context
         n_disp = max(min(args.steps, spec.seq_len // t_chunk - 1), 1)
-        pwindow = 1 << max((t_chunk * (n_disp + 1)).bit_length(), 8)
+        pwindow = 1 << max((t_chunk * (n_disp + 1) - 1).bit_length(), 8)
         pwindow = None if pwindow >= spec.seq_len else pwindow
         step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
                                     donate_cache=True, attn_window=pwindow)

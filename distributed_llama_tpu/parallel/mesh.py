@@ -47,10 +47,9 @@ def init_multihost(coordinator: str | None = None, num_processes: int | None = N
     `init_multihost()` suffices; elsewhere pass coordinator="host0:1234",
     num_processes and process_id explicitly. Returns this host's process index.
     """
-    kw = {}
-    if coordinator is not None:
-        kw = dict(coordinator_address=coordinator, num_processes=num_processes,
-                  process_id=process_id)
+    kw = {k: v for k, v in (("coordinator_address", coordinator),
+                            ("num_processes", num_processes),
+                            ("process_id", process_id)) if v is not None}
     jax.distributed.initialize(**kw)
     return jax.process_index()
 
@@ -70,12 +69,15 @@ def make_pod_mesh(tp: int | None = None, sp: int = 1, dp: int | None = None) -> 
 
     n_local = jax.local_device_count()
     n_proc = jax.process_count()
-    if dp is None:
-        dp = n_proc
+    n_total = n_local * n_proc
     if tp is None:
-        assert n_local % sp == 0, (n_local, sp)
-        tp = (n_local * n_proc) // (dp * sp)
-    assert dp * sp * tp == n_local * n_proc, (dp, sp, tp, n_local, n_proc)
+        dp = dp if dp is not None else n_proc
+        assert n_total % (dp * sp) == 0, (n_total, dp, sp)
+        tp = n_total // (dp * sp)
+    elif dp is None:
+        assert n_total % (sp * tp) == 0, (n_total, sp, tp)
+        dp = n_total // (sp * tp)
+    assert dp * sp * tp == n_total, (dp, sp, tp, n_local, n_proc)
     if n_proc == 1:
         return make_mesh(tp=tp, sp=sp, dp=dp)
     assert dp % n_proc == 0, (

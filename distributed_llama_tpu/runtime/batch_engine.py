@@ -158,7 +158,7 @@ class BatchEngine:
         with self._plock:
             for s in self._slots:
                 req = s.req
-                if req is not None:
+                if req is not None and not req.done.is_set():
                     req.error = err
                     s.req = None
                     s.pending = []

@@ -184,8 +184,7 @@ def test_llama31_405b_spec_shards_at_full_scale():
     }
 
     mesh = make_mesh(tp=8)
-    rope_shape = RopeTables.create(  # real tables are small; build them for real
-        ModelSpec(**{**spec.__dict__}).resolved())
+    rope_shape = RopeTables.create(spec)  # real tables are small; build them for real
     from distributed_llama_tpu.parallel.sharding import effective_kv_heads
     hk = effective_kv_heads(spec, 8)
     cache = jax.ShapeDtypeStruct(
@@ -198,3 +197,16 @@ def test_llama31_405b_spec_shards_at_full_scale():
     logits, kc, vc = out
     assert logits.shape == (1, 1, spec.vocab_size)
     assert kc.shape == cache.shape
+
+
+def test_make_pod_mesh_single_host_layouts():
+    """make_pod_mesh (the DCN-aware builder) on one host must accept partial-fill
+    tp/sp and infer the rest — the same contract as make_mesh."""
+    from distributed_llama_tpu.parallel.mesh import make_pod_mesh
+
+    m = make_pod_mesh(tp=4)  # dp inferred = 2 on the 8-device harness
+    assert m.shape == {"dp": 2, "sp": 1, "tp": 4}
+    m = make_pod_mesh(sp=2)  # tp inferred with dp defaulting to n_proc (=1)
+    assert m.shape == {"dp": 1, "sp": 2, "tp": 4}
+    with pytest.raises(AssertionError):
+        make_pod_mesh(tp=3)  # 8 devices not divisible
