@@ -68,9 +68,15 @@ def _load() -> ctypes.CDLL | bool:
     if so is None:
         return False
     try:
-        lib = ctypes.CDLL(so)
-    except OSError:
+        lib = _bind(ctypes.CDLL(so))
+    except (OSError, AttributeError):
+        # AttributeError = stale cached .so missing a newer symbol (mtime check can
+        # be fooled on NFS/image-layer checkouts): fall back, never crash
         return False
+    return lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     i64, u8p, u16p, i8p, f32p, i32p = (
         ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
         ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int8),
@@ -78,6 +84,7 @@ def _load() -> ctypes.CDLL | bool:
     lib.dlt_q40_deinterleave.argtypes = [u8p, i64, u8p, u16p]
     lib.dlt_q80_deinterleave.argtypes = [u8p, i64, i8p, u16p]
     lib.dlt_q40_to_i8.argtypes = [u8p, u16p, i64, i8p, f32p]
+    lib.dlt_q40_to_i4p.argtypes = [u8p, i64, i64, u8p]
     lib.dlt_f16_to_f32.argtypes = [u16p, i64, f32p]
     lib.dlt_xorshift_f32_fill.restype = ctypes.c_uint64
     lib.dlt_xorshift_f32_fill.argtypes = [ctypes.c_uint64, i64, ctypes.c_double, f32p]
@@ -147,6 +154,25 @@ def q40_to_i8(packed: np.ndarray, scales: np.ndarray
     lead = packed.shape[:-2]
     nbl = packed.shape[-2]
     return vals.reshape(*lead, nbl * 32), sc.reshape(*lead, nbl)
+
+
+def q40_to_i4p(packed: np.ndarray, col_groups: int = 1) -> np.ndarray | None:
+    """Planar Q40 (..., nb, 16) u8 -> split-plane packed nibbles (..., nb*16) u8,
+    packed per column group (QTensor.to_i4p_layout's hot loop; scales pass through
+    unchanged at the caller)."""
+    lib = _get()
+    if lib is None:
+        return None
+    lead = packed.shape[:-2]
+    nbl = packed.shape[-2]
+    if (nbl * 32) % col_groups or (nbl * 32 // col_groups) % 64:
+        return None
+    kl = nbl * 32 // col_groups
+    units = int(np.prod(lead, initial=1)) * col_groups
+    p = np.ascontiguousarray(packed).reshape(units, -1)
+    out = np.empty((units, kl // 2), np.uint8)
+    lib.dlt_q40_to_i4p(_ptr(p, ctypes.c_uint8), units, kl, _ptr(out, ctypes.c_uint8))
+    return out.reshape(*lead, nbl * 16)
 
 
 def xorshift_f32_fill(state: int, n: int, div: float = 1.0

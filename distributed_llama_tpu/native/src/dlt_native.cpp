@@ -55,10 +55,12 @@ float f16_to_f32(uint16_t h) {
 }
 
 template <typename F>
-void parallel_blocks(int64_t n, F body) {
+void parallel_blocks(int64_t n, F body, int64_t item_bytes = 16) {
     unsigned hw = std::thread::hardware_concurrency();
     int64_t nthreads = (int64_t)(hw ? hw : 4);
-    if (nthreads > n / 4096) nthreads = n / 4096;  // don't spawn for tiny work
+    // don't spawn threads for < ~64 KB of work each
+    int64_t max_useful = (n * item_bytes) / 65536;
+    if (nthreads > max_useful) nthreads = max_useful;
     if (nthreads <= 1) { body((int64_t)0, n); return; }
     std::vector<std::thread> ts;
     int64_t per = (n + nthreads - 1) / nthreads;
@@ -115,6 +117,27 @@ void dlt_q40_to_i8(const uint8_t* packed, const uint16_t* d16, int64_t nb,
             scales_out[i] = f16_to_f32(d16[i]);
         }
     });
+}
+
+// Planar Q40 -> split-plane packed nibbles ("i4p", QTensor.to_i4p_layout's hot loop):
+// per (row, column-group) unit of kl elements, output byte j = q[j] | (q[j+kl/2] << 4)
+// where q is the natural-order stored nibble (already carries the +8 offset). Scales
+// pass through untouched (they stay f16). `units` = rows * col_groups.
+void dlt_q40_to_i4p(const uint8_t* packed, int64_t units, int64_t kl, uint8_t* out) {
+    const int64_t nbg = kl / QK, kh = kl / 2;
+    parallel_blocks(units, [=](int64_t lo, int64_t hi) {
+        for (int64_t u = lo; u < hi; ++u) {
+            const uint8_t* src = packed + u * nbg * 16;
+            uint8_t* dst = out + u * kh;
+            auto nib = [&](int64_t e) -> uint8_t {
+                int64_t b = e >> 5, p = e & 31;  // block, position within block
+                uint8_t byte = src[b * 16 + (p & 15)];
+                return p < 16 ? (uint8_t)(byte & 0x0F) : (uint8_t)(byte >> 4);
+            };
+            for (int64_t j = 0; j < kh; ++j)
+                dst[j] = (uint8_t)(nib(j) | (nib(j + kh) << 4));
+        }
+    }, kh);
 }
 
 // f16 bits -> f32 array (Q80 scale decode and general .m f16 tensors).

@@ -315,6 +315,12 @@ class QTensor:
         assert self.layout == "planar" and self.ftype == FloatType.Q40, (
             self.layout, self.ftype)
         packed = np.asarray(self.data)  # (..., nb, 16)
+        from . import native
+
+        nat = native.q40_to_i4p(packed, col_groups)
+        if nat is not None:
+            return QTensor(self.ftype, nat, np.asarray(self.scales, dtype=np.float16),
+                           layout="i4p", groups=col_groups)
         lo = (packed & 0x0F).astype(np.uint8)  # block elements 0..15
         hi = (packed >> 4).astype(np.uint8)  # block elements 16..31
         q = np.concatenate([lo, hi], axis=-1)  # (..., nb, 32) natural order, in [0,16)
