@@ -36,7 +36,8 @@ from jax.experimental.pallas import tpu as pltpu
 from ..quants import QK, FloatType, QTensor
 
 
-def _matvec_kernel(xexp_ref, sx_ref, wp_ref, s_ref, o_ref):
+def _unpack_dot_epilogue(xexp_ref, sx_ref, wp_ref, s_ref, o_ref):
+    """Shared kernel body: split-plane unpack, per-half MXU dots, scale epilogue."""
     wp = wp_ref[:]  # (bn, K/2) uint8
     lo = (wp & jnp.uint8(0x0F)).astype(jnp.int8) - 8  # elements [0, K/2)
     hi = (wp >> 4).astype(jnp.int8) - 8  # elements [K/2, K)
@@ -48,6 +49,26 @@ def _matvec_kernel(xexp_ref, sx_ref, wp_ref, s_ref, o_ref):
                              preferred_element_type=jnp.int32)
     y = (s_ref[:].astype(jnp.float32) * sx_ref[:]) * p.astype(jnp.float32)
     o_ref[:] = jnp.sum(y, axis=1, keepdims=True)
+
+
+def _matvec_kernel(xexp_ref, sx_ref, wp_ref, s_ref, o_ref):
+    _unpack_dot_epilogue(xexp_ref, sx_ref, wp_ref, s_ref, o_ref)
+
+
+def _matvec_kernel_inline(xq_ref, sx_ref, wp_ref, s_ref, o_ref, xexp_ref):
+    """Variant generating the block-diagonal Xexp in VMEM scratch from the raw int8
+    activation row (k bytes of HBM instead of k*nb): built once at grid step 0, reused
+    by every row block."""
+    k, nb = xexp_ref.shape
+
+    @pl.when(pl.program_id(0) == 0)
+    def _build():
+        row = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
+        xexp_ref[:] = jnp.where(row // QK == col, xq_ref[0][:, None],
+                                jnp.int8(0)).astype(jnp.int8)
+
+    _unpack_dot_epilogue(xexp_ref, sx_ref, wp_ref, s_ref, o_ref)
 
 
 def _pick_bn(n: int, k: int, budget_bytes: int = 3 << 20) -> int:
@@ -99,8 +120,38 @@ def _q4_matvec(xexp, sx, wp, scales, *, interpret: bool = False):
     )(xexp, sx, wp, scales)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q4_matvec_inline(xq, sx, wp, scales, *, interpret: bool = False):
+    """Inline-Xexp variant: xq (1, K) int8 streamed to VMEM; the block-diagonal
+    operand lives only in kernel scratch."""
+    _, k = xq.shape
+    n, kh = wp.shape
+    nb = k // QK
+    assert kh * 2 == k and scales.shape == (n, nb), (xq.shape, wp.shape, scales.shape)
+    bn = _pick_bn(n, k)
+    return pl.pallas_call(
+        _matvec_kernel_inline,
+        grid=(pl.cdiv(n, bn),),
+        in_specs=[
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, kh), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((k, nb), jnp.int8)],
+        interpret=interpret,
+    )(xq, sx, wp, scales)
+
+
+# flip after measuring on hardware (perf/microbench.py --section matvec compares both)
+INLINE_XEXP_DEFAULT = False
+
+
 def q4_matvec(x: jax.Array, w: QTensor, *, out_dtype=None,
-              interpret: bool | None = None) -> jax.Array:
+              interpret: bool | None = None,
+              inline_xexp: bool | None = None) -> jax.Array:
     """Decode-path matmul: x (..., K) with leading dims multiplying to 1, i4p-layout
     QTensor (N, K) -> (..., N)."""
     if w.layout != "i4p":
@@ -108,11 +159,17 @@ def q4_matvec(x: jax.Array, w: QTensor, *, out_dtype=None,
     assert w.data.ndim == 2, w.data.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    from .pallas_q8 import _expand_q80
+    if inline_xexp is None:
+        inline_xexp = INLINE_XEXP_DEFAULT
+    from .pallas_q8 import _expand_q80, _quantize_row
 
     lead = x.shape[:-1]
     k = x.shape[-1]
     nb = k // QK
-    xexp, sx = _expand_q80(x.reshape(k), nb)
-    y = _q4_matvec(xexp, sx, w.data, w.scales, interpret=interpret)
+    if inline_xexp:
+        xq, sx = _quantize_row(x.reshape(k), nb)
+        y = _q4_matvec_inline(xq[None, :], sx, w.data, w.scales, interpret=interpret)
+    else:
+        xexp, sx = _expand_q80(x.reshape(k), nb)
+        y = _q4_matvec(xexp, sx, w.data, w.scales, interpret=interpret)
     return y.reshape(*lead, y.shape[0]).astype(out_dtype or x.dtype)

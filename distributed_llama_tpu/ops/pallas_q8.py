@@ -106,23 +106,31 @@ def _q8_matvec(xexp, sx, w8, scales, *, interpret: bool = False, precise: bool =
     )(xexp, sx, w8, scales)
 
 
-def _expand_q80(x_row: jax.Array, nb: int):
-    """Quantize one activation row (K,) to per-block int8 and scatter block-diagonally.
-
-    Returns (Xexp (K, nb) int8, sx (1, nb) f32). Runs in XLA outside the kernel, where
-    the quantize fuses with the producer (the reference quantizes activations to Q80
-    before every sliced matmul the same way, src/tasks.cpp:96-135).
-    """
+def _quantize_row(x_row: jax.Array, nb: int):
+    """Per-32-block Q80 quantization of one activation row (K,) -> (xq (K,) int8,
+    sx (1, nb) f32). Exactly the reference's Q80 buffer semantics
+    (src/tasks.cpp:96-135)."""
     k = x_row.shape[0]
     g = x_row.reshape(nb, QK).astype(jnp.float32)
     absmax = jnp.max(jnp.abs(g), axis=-1)
     sx = absmax / 127.0
     inv = jnp.where(absmax > 0, 127.0 / absmax, 0.0)
     xq = jnp.round(g * inv[:, None]).astype(jnp.int8).reshape(k)
+    return xq, sx[None, :]
+
+
+def _expand_q80(x_row: jax.Array, nb: int):
+    """Quantize one activation row (K,) to per-block int8 and scatter block-diagonally.
+
+    Returns (Xexp (K, nb) int8, sx (1, nb) f32). Runs in XLA outside the kernel, where
+    the quantize fuses with the producer.
+    """
+    k = x_row.shape[0]
+    xq, sx = _quantize_row(x_row, nb)
     block_of = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0) // QK
     b_idx = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
     xexp = jnp.where(block_of == b_idx, xq[:, None], jnp.int8(0))
-    return xexp, sx[None, :]
+    return xexp, sx
 
 
 def _expand_f32(x_row: jax.Array, nb: int):

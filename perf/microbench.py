@@ -107,15 +107,21 @@ def sec_matvec(reps):
     shapes = [(4096, 4096), (11008, 4096), (4096, 11008), (32000, 4096)]
     for n, k in shapes:
         w = _rand_q40(min(n, 4096) if not on_tpu else n, k)
-        for layout in ("i4p", "i8"):
-            wl = w.to_i4p_layout() if layout == "i4p" else w.to_i8_layout()
+        w_i4p = w.to_i4p_layout()
+        for layout in ("i4p", "i4p-inline", "i8"):
+            wl = w.to_i8_layout() if layout == "i8" else w_i4p
             wl = jax.tree_util.tree_map(jnp.asarray, wl)
             x = jnp.ones((1, 1, k), jnp.bfloat16)
-            if layout == "i4p":
-                from distributed_llama_tpu.ops.pallas_q4 import q4_matvec as mv
-            else:
+            if layout == "i8":
                 from distributed_llama_tpu.ops.pallas_q8 import q8_matvec as mv
-            g = jax.jit(functools.partial(mv, interpret=not on_tpu))
+
+                g = jax.jit(functools.partial(mv, interpret=not on_tpu))
+            else:
+                from distributed_llama_tpu.ops.pallas_q4 import q4_matvec
+
+                g = jax.jit(functools.partial(
+                    q4_matvec, interpret=not on_tpu,
+                    inline_xexp=layout == "i4p-inline"))
             dt = timed(g, x, wl, reps=reps)
             bytes_ = wl.data.nbytes + wl.scales.nbytes
             emit(section="matvec", layout=layout, n=wl.shape[0], k=k,

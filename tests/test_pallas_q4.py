@@ -173,3 +173,16 @@ def test_windowed_forward_equals_full():
     got2, _, _ = forward(params, spec, rope, tok, kcw, vcw, jnp.int32(5),
                          attn_window=16)
     np.testing.assert_array_equal(np.asarray(got2), np.asarray(want2))
+
+
+def test_q4_inline_xexp_matches_standard():
+    """The scratch-built Xexp variant must produce bit-identical results to the
+    HBM-materialized one (same int8 quantization, same dots)."""
+    rng = np.random.RandomState(21)
+    n, k = 128, 512
+    w = QTensor.from_float((rng.randn(n, k) * 0.05).astype(np.float32), FloatType.Q40)
+    wi = _to_jnp(w.to_i4p_layout())
+    x = jnp.asarray(rng.randn(1, k).astype(np.float32)).astype(jnp.bfloat16)
+    y0 = np.asarray(q4_matvec(x, wi, interpret=True, inline_xexp=False))
+    y1 = np.asarray(q4_matvec(x, wi, interpret=True, inline_xexp=True))
+    np.testing.assert_array_equal(y0, y1)
