@@ -59,14 +59,13 @@ def _matvec_kernel_inline(xq_ref, sx_ref, wp_ref, s_ref, o_ref, xexp_ref):
     """Variant generating the block-diagonal Xexp in VMEM scratch from the raw int8
     activation row (k bytes of HBM instead of k*nb): built once at grid step 0, reused
     by every row block."""
-    k, nb = xexp_ref.shape
+    _, nb = xexp_ref.shape
 
     @pl.when(pl.program_id(0) == 0)
     def _build():
-        row = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
-        xexp_ref[:] = jnp.where(row // QK == col, xq_ref[0][:, None],
-                                jnp.int8(0)).astype(jnp.int8)
+        from .pallas_q8 import block_diag_scatter
+
+        xexp_ref[:] = block_diag_scatter(xq_ref[0], nb)
 
     _unpack_dot_epilogue(xexp_ref, sx_ref, wp_ref, s_ref, o_ref)
 

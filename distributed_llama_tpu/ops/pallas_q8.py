@@ -119,26 +119,28 @@ def _quantize_row(x_row: jax.Array, nb: int):
     return xq, sx[None, :]
 
 
+def block_diag_scatter(xq: jax.Array, nb: int) -> jax.Array:
+    """Scatter a quantized row (K,) block-diagonally: Xexp[j, b] = xq[j] iff
+    j // QK == b. Pure jnp — usable both in XLA and inside Pallas kernel bodies."""
+    k = xq.shape[0]
+    block_of = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0) // QK
+    b_idx = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
+    return jnp.where(block_of == b_idx, xq[:, None], jnp.zeros((), xq.dtype))
+
+
 def _expand_q80(x_row: jax.Array, nb: int):
     """Quantize one activation row (K,) to per-block int8 and scatter block-diagonally.
 
     Returns (Xexp (K, nb) int8, sx (1, nb) f32). Runs in XLA outside the kernel, where
     the quantize fuses with the producer.
     """
-    k = x_row.shape[0]
     xq, sx = _quantize_row(x_row, nb)
-    block_of = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0) // QK
-    b_idx = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
-    xexp = jnp.where(block_of == b_idx, xq[:, None], jnp.int8(0))
-    return xexp, sx
+    return block_diag_scatter(xq, nb), sx
 
 
 def _expand_f32(x_row: jax.Array, nb: int):
     """Precise-path variant: no activation quantization, unit block scales."""
-    k = x_row.shape[0]
-    block_of = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0) // QK
-    b_idx = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
-    xexp = jnp.where(block_of == b_idx, x_row.astype(jnp.float32)[:, None], 0.0)
+    xexp = block_diag_scatter(x_row.astype(jnp.float32), nb)
     return xexp, jnp.ones((1, nb), jnp.float32)
 
 

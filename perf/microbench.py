@@ -107,10 +107,10 @@ def sec_matvec(reps):
     shapes = [(4096, 4096), (11008, 4096), (4096, 11008), (32000, 4096)]
     for n, k in shapes:
         w = _rand_q40(min(n, 4096) if not on_tpu else n, k)
-        w_i4p = w.to_i4p_layout()
+        w_i4p = jax.tree_util.tree_map(jnp.asarray, w.to_i4p_layout())
         for layout in ("i4p", "i4p-inline", "i8"):
-            wl = w.to_i8_layout() if layout == "i8" else w_i4p
-            wl = jax.tree_util.tree_map(jnp.asarray, wl)
+            wl = (jax.tree_util.tree_map(jnp.asarray, w.to_i8_layout())
+                  if layout == "i8" else w_i4p)
             x = jnp.ones((1, 1, k), jnp.bfloat16)
             if layout == "i8":
                 from distributed_llama_tpu.ops.pallas_q8 import q8_matvec as mv

@@ -96,3 +96,23 @@ def test_collective_bytes_model():
     comp = collective_kbytes_per_token(spec, 4, compress=True)
     assert full > comp > 0
     assert collective_kbytes_per_token(spec, 1, False) == 0.0
+
+
+def test_window_bucket_transitions_match_full(monkeypatch):
+    """A generation that crosses window buckets (16 -> 32 -> full) must emit exactly
+    the tokens of an engine that never windows: bucket growth only changes which dead
+    cache positions are read."""
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=4, vocab_size=256, seq_len=64).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=19)
+
+    full = Engine(spec, params, tp=2)  # seq_len 64 <= default _WINDOW_MIN: never windows
+    sampler = Sampler(spec.vocab_size, temperature=0.0)
+    want, _ = full.generate([1, 7, 23], 40, sampler)
+
+    monkeypatch.setattr(Engine, "_WINDOW_MIN", 16)
+    windowed = Engine(spec, params, tp=2)
+    got, _ = windowed.generate([1, 7, 23], 40, Sampler(spec.vocab_size, temperature=0.0))
+    assert got == want
+    # multiple buckets were actually compiled (16 and 32 at least, then full)
+    assert {16, 32} <= {w for w in windowed._steps if w is not None}

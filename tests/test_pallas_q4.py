@@ -175,11 +175,15 @@ def test_windowed_forward_equals_full():
     np.testing.assert_array_equal(np.asarray(got2), np.asarray(want2))
 
 
-def test_q4_inline_xexp_matches_standard():
+def test_q4_inline_xexp_matches_standard(monkeypatch):
     """The scratch-built Xexp variant must produce bit-identical results to the
-    HBM-materialized one (same int8 quantization, same dots)."""
+    HBM-materialized one (same int8 quantization, same dots) — across a MULTI-step
+    grid, so the build-at-step-0/reuse-later scratch mechanism is actually exercised."""
+    import distributed_llama_tpu.ops.pallas_q4 as pq4
+
+    monkeypatch.setattr(pq4, "_pick_bn", lambda n, k, budget_bytes=0: 128)
     rng = np.random.RandomState(21)
-    n, k = 128, 512
+    n, k = 512, 512  # grid = 4 row blocks
     w = QTensor.from_float((rng.randn(n, k) * 0.05).astype(np.float32), FloatType.Q40)
     wi = _to_jnp(w.to_i4p_layout())
     x = jnp.asarray(rng.randn(1, k).astype(np.float32)).astype(jnp.bfloat16)
