@@ -38,7 +38,8 @@ import numpy as np
 
 sys.path.insert(0, "/root/repo")
 
-from distributed_llama_tpu.models.params import block_tensor_shapes  # noqa: E402
+from distributed_llama_tpu.models.params import (  # noqa: E402
+    block_tensor_shapes, decode_stream_bytes)
 from distributed_llama_tpu.models.spec import (  # noqa: E402
     ArchType, HiddenAct, ModelSpec, RopeType)
 from distributed_llama_tpu.ops.rope import RopeTables  # noqa: E402
@@ -118,17 +119,6 @@ def synth_params(spec: ModelSpec, layout: str):
     }
 
 
-def params_bytes(params, spec: ModelSpec) -> int:
-    """Weight + scale bytes DECODE streams per token (embedding row reads excluded).
-    MoE expert stacks count only the n_active_experts slices a decode step actually
-    moves through HBM."""
-    total = 0
-    for name, t in list(params["blocks"].items()) + [("wcls", params["wcls"])]:
-        n = t.nbytes() if isinstance(t, QTensor) else t.nbytes
-        if name.startswith("moe_"):
-            n = n * spec.n_active_experts // spec.n_experts
-        total += n
-    return total
 
 
 def vs_baseline(args, tok_s: float):
@@ -176,7 +166,7 @@ def main():
     params = synth_params(spec, layout)
     params = shard_params(params, mesh, spec)
     rope = RopeTables.create(spec)
-    wbytes = params_bytes(params, spec)
+    wbytes = decode_stream_bytes(params, spec)
     kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
 
     # NOTE: on the axon TPU tunnel, block_until_ready() returns before the device is

@@ -147,6 +147,10 @@ def mode_inference(args) -> None:
     print(f"Avg tokens / second: {stats.tokens_per_second:.2f}")
     print(f"Avg generation time: {stats.avg_token_ms:.2f} ms")
     print(f"Avg inference time:  {stats.avg_infer_ms:.2f} ms")
+    if stats.avg_infer_ms > 0:
+        gbps = engine.decode_weight_bytes / engine.tp / 1e9 / (stats.avg_infer_ms / 1e3)
+        print(f"Weight stream:       {gbps:.1f} GB/s per chip "
+              f"({engine.decode_weight_bytes / 1e9:.3f} GB/step global)")
     print(f"Prefill time:        {stats.prefill_ms:.2f} ms "
           f"({stats.prompt_tokens} tokens)")
 

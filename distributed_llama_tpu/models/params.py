@@ -138,6 +138,19 @@ def prepare_for_pallas(params: Params, tp: int = 1) -> Params:
     return out
 
 
+def decode_stream_bytes(params: Params, spec: ModelSpec) -> int:
+    """Weight + scale bytes one decode step streams from HBM (embedding row reads
+    excluded; MoE expert stacks count only the n_active_experts slices actually
+    moved per token). The numerator of the achieved-GB/s observability metric."""
+    total = 0
+    for name, t in list(params["blocks"].items()) + [("wcls", params["wcls"])]:
+        n = t.nbytes() if isinstance(t, QTensor) else t.nbytes
+        if name.startswith("moe_") and spec.n_experts:
+            n = n * spec.n_active_experts // spec.n_experts
+        total += n
+    return total
+
+
 def map_params(params: Params, fn: Callable[[Any], Any]) -> Params:
     """Apply fn to every QTensor/array leaf group (QTensor treated atomically)."""
     out: Params = {}

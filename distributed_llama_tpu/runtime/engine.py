@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.params import Params, prepare_for_pallas
+from ..models.params import Params, decode_stream_bytes, prepare_for_pallas
 from ..models.spec import ModelSpec
 from ..ops.rope import RopeTables
 from ..parallel.mesh import AXIS_TP, make_mesh
@@ -105,6 +105,9 @@ class Engine:
         if self.use_pallas:
             params = prepare_for_pallas(params, self.tp)
         self.params = shard_params(params, self.mesh, spec)
+        # global (all-shard) weight bytes one decode step streams — per-chip traffic
+        # divides by tp; used for the achieved-GB/s printout (perf/PROFILE.md)
+        self.decode_weight_bytes = decode_stream_bytes(self.params, spec)
         self.rope = RopeTables.create(spec)
         self.batch = batch
         self._steps: dict[int | None, object] = {}  # attn_window bucket -> jitted step
