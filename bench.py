@@ -130,6 +130,34 @@ def vs_baseline(args, tok_s: float):
     return None
 
 
+def probe_backend(timeout_s: float = 180.0) -> tuple[str | None, str]:
+    """Resolve the backend AND fence a tiny op under a watchdog. The axon tunnel can
+    wedge such that even backend initialization hangs forever (observed 2026-07-29:
+    >4 h outage); without this, a bench run would hang instead of reporting. Returns
+    (backend name or None, failure description)."""
+    import threading
+
+    got: list[str] = []
+    err: list[str] = []
+
+    def probe():
+        try:
+            b = jax.default_backend()  # triggers PJRT/tunnel init
+            np.asarray(jnp.ones((4,)) + 1)
+            got.append(b)
+        except Exception as e:
+            err.append(f"device init/probe raised: {e!r}")
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if got:
+        return got[0], ""
+    return None, (err[0] if err else
+                  f"backend init / a trivial fenced op did not complete within "
+                  f"{timeout_s:.0f} s (known axon outage mode; see perf/PROFILE.md)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true", help="tiny model (CI smoke)")
@@ -149,7 +177,18 @@ def main():
                     help="write a jax.profiler trace of the timed region here")
     args = ap.parse_args()
 
-    on_tpu = jax.default_backend() == "tpu"
+    backend, fail = probe_backend()
+    if backend is None:
+        kind = "prefill" if args.prefill > 0 else "decode"
+        name = (f"{args.arch}_q40_{kind}_tok_s" if not args.small
+                else f"small_q40_{kind}_tok_s")
+        print(json.dumps({
+            "metric": name, "value": 0.0, "unit": "tok/s", "vs_baseline": 0.0,
+            "error": f"TPU unreachable: {fail}",
+        }))
+        sys.exit(2)
+
+    on_tpu = backend == "tpu"
     spec = ModelSpec(**(SMALL if args.small else ARCHS[args.arch])).resolved()
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     layout = args.layout if on_tpu else "planar"
