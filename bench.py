@@ -130,6 +130,13 @@ def vs_baseline(args, tok_s: float):
     return None
 
 
+def metric_name(args) -> str:
+    kind = "prefill" if args.prefill > 0 else "decode"
+    if args.small:
+        return f"small_{kind}_tok_s" if kind == "prefill" else "small_q40_decode_tok_s"
+    return f"{args.arch}_q40_{kind}_tok_s"
+
+
 def probe_backend(timeout_s: float = 180.0) -> tuple[str | None, str]:
     """Resolve the backend AND fence a tiny op under a watchdog. The axon tunnel can
     wedge such that even backend initialization hangs forever (observed 2026-07-29:
@@ -179,11 +186,9 @@ def main():
 
     backend, fail = probe_backend()
     if backend is None:
-        kind = "prefill" if args.prefill > 0 else "decode"
-        name = (f"{args.arch}_q40_{kind}_tok_s" if not args.small
-                else f"small_q40_{kind}_tok_s")
         print(json.dumps({
-            "metric": name, "value": 0.0, "unit": "tok/s", "vs_baseline": 0.0,
+            "metric": metric_name(args), "value": 0.0, "unit": "tok/s",
+            "vs_baseline": 0.0,
             "error": f"TPU unreachable: {fail}",
         }))
         sys.exit(2)
@@ -243,9 +248,8 @@ def main():
             np.asarray(logits[0, 0, 0])
             dt_all = time.perf_counter() - t0
         tok_s = n_disp * t_chunk / dt_all
-        name = f"{args.arch}_q40_prefill_tok_s" if not args.small else "small_prefill_tok_s"
         print(json.dumps({
-            "metric": name, "value": round(tok_s, 1), "unit": "tok/s",
+            "metric": metric_name(args), "value": round(tok_s, 1), "unit": "tok/s",
             "vs_baseline": vs_baseline(args, tok_s),
             "chunk": t_chunk, "weight_gb": round(wbytes / 1e9, 3),
             "ms_per_chunk": round(dt_all / n_disp * 1e3, 2),
@@ -290,9 +294,8 @@ def main():
             dt = (time.perf_counter() - t0) / args.steps
 
     tok_s = 1.0 / dt
-    name = f"{args.arch}_q40_decode_tok_s" if not args.small else "small_q40_decode_tok_s"
     print(json.dumps({
-        "metric": name,
+        "metric": metric_name(args),
         "value": round(tok_s, 3),
         "unit": "tok/s",
         "vs_baseline": vs_baseline(args, tok_s),

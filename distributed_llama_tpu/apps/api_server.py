@@ -304,8 +304,13 @@ def main(argv=None) -> None:
                    help="continuous-batching slots: up to N requests decode "
                         "concurrently in one batched step (1 = reference-style "
                         "serialized serving)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel mesh axis: shard the --batch cache rows over "
+                        "N device groups (requires --batch divisible by N)")
     args = p.parse_args(argv)
     batch_engine = None
+    if args.dp > 1 and args.batch <= 1:
+        p.error("--dp requires --batch > 1 (data parallelism shards batched cache rows)")
     if args.batch > 1:
         if args.sp > 1:
             p.error("--batch > 1 requires --sp 1: per-row cache positions are "
@@ -319,7 +324,7 @@ def main(argv=None) -> None:
             args.model, args.tokenizer, max_seq_len=args.max_seq_len,
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
-            slots=args.batch, tp=args.tp,
+            slots=args.batch, tp=args.tp, dp=args.dp,
             dtype=(None if args.dtype == "auto"
                    else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
             use_pallas=False if args.no_pallas else None,

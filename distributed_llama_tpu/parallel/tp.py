@@ -121,8 +121,11 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
     """
     import jax.numpy as jnp
 
+    from .mesh import AXIS_DP
+
     tp = mesh.shape[AXIS_TP]
     sp = mesh.shape.get(AXIS_SP, 1)
+    dp = mesh.shape.get(AXIS_DP, 1)
     check_divisibility(spec, tp, sp)
     dtype = dtype or jnp.float32
     if sp > 1:
@@ -130,6 +133,13 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
 
     param_specs = _expand_pspec_tree(params, param_pspecs(params))
     kv_spec = kv_cache_pspec_for_mesh(mesh)
+    # data parallelism: batch rows shard over dp (cache rows already carry AXIS_DP on
+    # their batch axis); each dp group runs an independent replica of the tp/sp
+    # program with zero cross-group traffic — the throughput axis the reference
+    # lacks entirely (batch hard-wired to 1, funcs.cpp:424). start_pos must then be
+    # per-row (B,), sharded alongside the rows.
+    tok_spec = P(AXIS_DP) if dp > 1 else P()
+    pos_spec = P(AXIS_DP) if dp > 1 else P()
 
     fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
@@ -145,8 +155,8 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
 
     sharded = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(param_specs, P(), P(), P(), kv_spec, kv_spec, P()),
-        out_specs=(P(), kv_spec, kv_spec),
+        in_specs=(param_specs, P(), P(), tok_spec, kv_spec, kv_spec, pos_spec),
+        out_specs=(tok_spec, kv_spec, kv_spec),
         check_vma=False,
     )
     donate = (4, 5) if donate_cache else ()

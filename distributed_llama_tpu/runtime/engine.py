@@ -81,7 +81,7 @@ def collective_kbytes_per_token(spec: ModelSpec, tp: int, compress: bool) -> flo
 
 class Engine:
     def __init__(self, spec: ModelSpec, params: Params, tokenizer: Tokenizer | None = None,
-                 *, tp: int | None = None, sp: int = 1, dtype=None,
+                 *, tp: int | None = None, sp: int = 1, dp: int = 1, dtype=None,
                  use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1):
         self.spec = spec
@@ -95,9 +95,13 @@ class Engine:
         self.compress = compress_collectives
         if use_pallas is None:
             use_pallas = on_tpu
-        self.mesh = make_mesh(tp=tp, sp=sp)
+        assert batch % dp == 0, (
+            f"batch={batch} must divide over dp={dp} (each dp shard holds "
+            "batch/dp cache rows)")
+        self.mesh = make_mesh(tp=tp, sp=sp, dp=dp)
         self.tp = self.mesh.shape[AXIS_TP]
         self.sp = sp
+        self.dp = dp
         has_quant = any(
             getattr(t, "ftype", None) in (FloatType.Q40, FloatType.Q80)
             for t in params["blocks"].values())
@@ -168,6 +172,13 @@ class Engine:
     def reset(self) -> None:
         self.pos = 0
 
+    def _pos_arg(self, pos):
+        """start_pos step argument: scalar normally, per-row (B,) under dp sharding
+        (the dp in_spec shards the row axis, so a scalar can't be passed)."""
+        if self.dp > 1:
+            return jnp.full((self.batch,), pos, jnp.int32)
+        return jnp.int32(pos)
+
     def collective_stats(self):
         """Exact per-decode-step collective traffic of the compiled step program.
 
@@ -181,7 +192,7 @@ class Engine:
             tokens = jnp.zeros((self.batch, 1), jnp.int32)
             closed = jax.make_jaxpr(self._step)(
                 self.params, self.rope, tokens, self.k_cache, self.v_cache,
-                jnp.int32(0))
+                self._pos_arg(0))
             self._measured_traffic = jaxpr_collective_traffic(
                 closed, dict(self.mesh.shape))
         return self._measured_traffic
@@ -201,7 +212,8 @@ class Engine:
 
         tokens = jnp.zeros((self.batch, 1), jnp.int32)
         lowered = jax.jit(self._step).lower(
-            self.params, self.rope, tokens, self.k_cache, self.v_cache, jnp.int32(0))
+            self.params, self.rope, tokens, self.k_cache, self.v_cache,
+            self._pos_arg(0))
         hlo = lowered.compile().as_text()
         self._compiled_traffic = collective_traffic(hlo, self.tp * self.sp)
         return self._compiled_traffic
@@ -234,9 +246,14 @@ class Engine:
         if self.pos + t > self.spec.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {t} > {self.spec.seq_len}")
         step = self._step_for(self._window_for(self.pos + t))
+        # the host loop drives ONE sequence; with batch>1 slots (BatchEngine backing
+        # store) or dp sharding, tile the row across the batch so token/cache/pos
+        # shapes stay congruent (rows 1.. do redundant work; BatchEngine drives the
+        # step directly with real per-row data instead)
+        toks = jnp.tile(jnp.asarray(tokens)[None, :], (self.batch, 1))
         logits, self.k_cache, self.v_cache = step(
-            self.params, self.rope, jnp.asarray(tokens)[None, :], self.k_cache,
-            self.v_cache, jnp.int32(self.pos))
+            self.params, self.rope, toks, self.k_cache,
+            self.v_cache, self._pos_arg(self.pos))
         self.pos += t
         return np.asarray(logits)[0, -1]
 

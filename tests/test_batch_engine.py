@@ -111,3 +111,27 @@ def test_context_end_finishes_length():
         assert 0 < len(out) <= 16
     finally:
         be.close()
+
+
+def test_batched_dp_sharded_matches_single_engine():
+    """dp=2 x tp=2: cache rows shard over the dp axis (each dp group an independent
+    replica of the tp program) and concurrent requests still reproduce the
+    single-engine greedy tokens exactly."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    eng = Engine(spec, params, tp=2)
+    prompts = [[1, 7, 23, 5], [1, 9, 2], [1, 4], [1, 30, 31, 32, 33]]
+    wants = []
+    for p in prompts:
+        eng.reset()
+        out, _ = eng.generate(list(p), 8, Sampler(spec.vocab_size, temperature=0.0))
+        wants.append(out)
+
+    be = BatchEngine(spec, params, slots=4, tp=2, dp=2)
+    try:
+        reqs = [be.submit(list(p), 8, Sampler(spec.vocab_size, temperature=0.0))
+                for p in prompts]
+        outs = [r.wait(timeout=180) for r in reqs]
+    finally:
+        be.close()
+    assert outs == wants
