@@ -198,6 +198,11 @@ def mode_chat(args) -> None:
         items.append(ChatItem("user", user.strip()))
         rendered = template.generate(items)
         prompt = tok.encode(rendered, add_bos=first)
+        if engine.pos + len(prompt) >= engine.spec.seq_len:
+            # next turn's prompt no longer fits the KV cache: hard stop at context
+            # end like the reference (dllama.cpp:190-192) instead of overflowing
+            print("\n(context end reached)")
+            break
         first = False
 
         print("\n🤖 Assistant\n", flush=True)
