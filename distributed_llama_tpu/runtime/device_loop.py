@@ -17,12 +17,15 @@ Under tensor parallelism the post-all-gather logits are replicated, so every dev
 computes the same sample — no extra collective is needed for the token broadcast (the
 reference ships `pos` over TCP instead: sendPos, src/tasks.cpp:137-152).
 
-Performance caveat (measured on the shared TPU v5 chip): XLA ping-pongs loop-carried
-buffers, so the KV caches lose the in-place aliasing they get as donated jit arguments —
-each scanned token pays ~2x cache bytes of extra HBM traffic. Where per-dispatch latency
-is small relative to that (big models, long contexts), Engine.generate's per-token
-dispatch loop is faster; the device loop wins when dispatch overhead dominates (small
-models, high-latency host links).
+Performance note (round 3): the round-2 measurement that found the device loop slower
+was taken when forward() restacked the full KV caches through scan xs/ys every token —
+the loop-carried copies it blamed were ~4 GB/token at 7B. forward() now carries the
+caches with layer-indexed in-place updates and windowed attention reads
+(models/forward.py), which removes that traffic for the host loop and the device loop
+alike; what remains for the device loop to win is amortizing the ~1.5-3.5 ms
+per-dispatch tunnel overhead across `n_steps` tokens per dispatch. Re-measure with
+`python bench.py --device-loop N` (the axon tunnel was down for the remainder of round
+3, so the post-redesign comparison is pending hardware).
 """
 
 from __future__ import annotations
