@@ -207,11 +207,32 @@ def main():
     window = None if window >= spec.seq_len else window
 
     mesh = make_mesh(tp=args.tp)
-    params = synth_params(spec, layout)
-    params = shard_params(params, mesh, spec)
     rope = RopeTables.create(spec)
-    wbytes = decode_stream_bytes(params, spec)
-    kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
+    state = {}
+
+    def build(lay):
+        params = shard_params(synth_params(spec, lay), mesh, spec)
+        state.update(params=params, layout=lay,
+                     wbytes=decode_stream_bytes(params, spec))
+        kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
+        return params, kc, vc
+
+    def compile_with_fallback(make_and_warm):
+        """Build + compile with the preferred layout; on failure retry once with the
+        int8-plane layout so unattended driver runs record a downgraded number (with
+        fallback_reason) instead of crashing. The failed set is dropped before the
+        retry so peak HBM holds one parameter set."""
+        nonlocal_layout = state.get("layout") or layout
+        try:
+            return make_and_warm(*build(nonlocal_layout))
+        except Exception as e:
+            if nonlocal_layout != "i4p":
+                raise
+            print(f"# i4p layout failed ({type(e).__name__}: {e}); retrying with i8",
+                  file=sys.stderr)
+            state.update(fallback_reason=f"{type(e).__name__}: {e}"[:200])
+            state.pop("params", None)  # free the failed set before re-synthesizing
+            return make_and_warm(*build("i8"))
 
     # NOTE: on the axon TPU tunnel, block_until_ready() returns before the device is
     # actually done; only a device->host transfer is an honest fence. Materialize a
@@ -234,11 +255,17 @@ def main():
         n_disp = max(min(args.steps, spec.seq_len // t_chunk - 1), 1)
         pwindow = 1 << max((t_chunk * (n_disp + 1) - 1).bit_length(), 8)
         pwindow = None if pwindow >= spec.seq_len else pwindow
-        step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
-                                    donate_cache=True, attn_window=pwindow)
         toks = jnp.ones((1, t_chunk), jnp.int32)
-        logits, kc, vc = step(params, rope, toks, kc, vc, jnp.int32(0))  # compile
-        np.asarray(logits[0, 0, 0])
+
+        def warm_prefill(params, kc, vc):
+            step = make_sharded_forward(spec, mesh, params, dtype=dtype,
+                                        use_pallas=on_tpu, donate_cache=True,
+                                        attn_window=pwindow)
+            logits, kc, vc = step(params, rope, toks, kc, vc, jnp.int32(0))  # compile
+            np.asarray(logits[0, 0, 0])
+            return step, params, kc, vc
+
+        step, params, kc, vc = compile_with_fallback(warm_prefill)
         pos = t_chunk
         with profile_ctx:
             t0 = time.perf_counter()
@@ -248,25 +275,33 @@ def main():
             np.asarray(logits[0, 0, 0])
             dt_all = time.perf_counter() - t0
         tok_s = n_disp * t_chunk / dt_all
-        print(json.dumps({
+        out = {
             "metric": metric_name(args), "value": round(tok_s, 1), "unit": "tok/s",
             "vs_baseline": vs_baseline(args, tok_s),
-            "chunk": t_chunk, "weight_gb": round(wbytes / 1e9, 3),
+            "chunk": t_chunk, "weight_gb": round(state["wbytes"] / 1e9, 3),
             "ms_per_chunk": round(dt_all / n_disp * 1e3, 2),
-        }))
+        }
+        if "fallback_reason" in state:
+            out["fallback_reason"] = state["fallback_reason"]
+        print(json.dumps(out))
         return
 
     if args.device_loop > 0:
         from distributed_llama_tpu.runtime.device_loop import make_decode_loop
 
         chunk = args.device_loop
-        loop = make_decode_loop(spec, mesh, params, chunk, mode="greedy", dtype=dtype,
-                                use_pallas=on_tpu, attn_window=window)
         key = jax.random.PRNGKey(0)
-        pos = 0
-        toks, _, kc, vc = loop(params, rope, 1, kc, vc, pos, key)  # compile + warm
-        np.asarray(toks)
-        pos += chunk
+
+        def warm_loop(params, kc, vc):
+            loop = make_decode_loop(spec, mesh, params, chunk, mode="greedy",
+                                    dtype=dtype, use_pallas=on_tpu,
+                                    attn_window=window)
+            toks, _, kc, vc = loop(params, rope, 1, kc, vc, 0, key)  # compile + warm
+            np.asarray(toks)
+            return loop, params, kc, vc
+
+        loop, params, kc, vc = compile_with_fallback(warm_loop)
+        pos = chunk
         n_disp = max(args.steps // chunk, 1)
         with profile_ctx:
             t0 = time.perf_counter()
@@ -276,10 +311,15 @@ def main():
             np.asarray(toks)
             dt = (time.perf_counter() - t0) / (n_disp * chunk)
     else:
-        step = make_sharded_forward(spec, mesh, params, dtype=dtype, use_pallas=on_tpu,
-                                    donate_cache=True, attn_window=window)
-        logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile + warm
-        np.asarray(logits[0, 0, 0])
+        def warm_step(params, kc, vc):
+            step = make_sharded_forward(spec, mesh, params, dtype=dtype,
+                                        use_pallas=on_tpu, donate_cache=True,
+                                        attn_window=window)
+            logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile
+            np.asarray(logits[0, 0, 0])
+            return step, params, kc, vc
+
+        step, params, kc, vc = compile_with_fallback(warm_step)
         for i in range(3):  # warm steps
             logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(1 + i))
         np.asarray(logits[0, 0, 0])
@@ -294,18 +334,21 @@ def main():
             dt = (time.perf_counter() - t0) / args.steps
 
     tok_s = 1.0 / dt
-    print(json.dumps({
+    out = {
         "metric": metric_name(args),
         "value": round(tok_s, 3),
         "unit": "tok/s",
         "vs_baseline": vs_baseline(args, tok_s),
         "ms_per_token": round(dt * 1e3, 3),
-        "weight_gb": round(wbytes / 1e9, 3),
-        "achieved_gbps": round(wbytes / 1e9 / dt, 1),
-        "layout": layout,
+        "weight_gb": round(state["wbytes"] / 1e9, 3),
+        "achieved_gbps": round(state["wbytes"] / 1e9 / dt, 1),
+        "layout": state["layout"],
         "attn_window": window or spec.seq_len,
         "device_loop": args.device_loop,
-    }))
+    }
+    if "fallback_reason" in state:
+        out["fallback_reason"] = state["fallback_reason"]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
