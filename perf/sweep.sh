@@ -1,0 +1,40 @@
+#!/bin/bash
+# One-shot hardware measurement sweep — run on a live TPU chip to collect every
+# pending A/B from the round-3 redesign (see perf/PROFILE.md). Each line is a JSON
+# record; tee everything into perf/sweep_results.jsonl for analysis.
+#
+#   bash perf/sweep.sh [outfile]
+set -e
+cd "$(dirname "$0")/.."
+OUT="${1:-perf/sweep_results.jsonl}"
+: > "$OUT"
+
+run() { echo "# $*" | tee -a "$OUT"; "$@" 2>/dev/null | tail -1 | tee -a "$OUT"; }
+
+# platform characteristics (dispatch overhead, streaming ceiling, kernel GB/s,
+# windowed-vs-full attention) — includes the i4p vs i4p-inline vs i8 kernel A/B
+python perf/microbench.py | tee -a "$OUT"
+
+# headline decode: 4-bit kernel, windowed attention, host loop
+run python bench.py --steps 64
+
+# kernel layout A/B at the model level
+run python bench.py --steps 64 --layout i8
+
+# window sweep: growing live-context cost (watchdog grows the bucket as needed)
+run python bench.py --steps 64 --window 2048
+
+# device loop: dispatch amortization after the carry-based cache redesign
+run python bench.py --steps 64 --device-loop 8
+run python bench.py --steps 64 --device-loop 32
+
+# prefill throughput (chunked prefill is a capability win over the reference)
+run python bench.py --prefill 64 --steps 16
+
+# the other BASELINE.json configs
+run python bench.py --arch tinyllama_1_1b --steps 64
+run python bench.py --arch llama3_8b --steps 64
+run python bench.py --arch mixtral_8x7b_l8 --steps 32
+run python bench.py --arch grok1_l2 --steps 32
+
+echo "sweep complete -> $OUT"
