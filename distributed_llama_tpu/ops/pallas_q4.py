@@ -8,7 +8,12 @@ unpacks in VMEM with zero cross-lane shuffles:
 
 Layout "i4p" (split-plane packing, `QTensor.to_i4p_layout`):
     data   uint8 (out, K/2):  byte j = q[j] | (q[j + K/2] << 4),  q = nibble+8 in [0,16)
-    scales f16   (out, K/32): the reference's per-block f16 deltas, bit-exact
+    scales int16 (out, K/32): the reference's per-block f16 deltas as raw BIT PATTERNS
+                              (bit-exact, same 2 B/block). Mosaic on this toolchain
+                              cannot lower f16 refs ("Unsupported type in mosaic
+                              dialect: 'f16'"), so the kernel ships the bits as int16
+                              and decodes f16->f32 in-kernel with exact integer math
+                              (`_f16_bits_to_f32`).
 
 Unpacking byte j's low nibble yields element j and the high nibble element j + K/2 —
 both planes land in natural element order, so the unpack is 4 elementwise VPU ops per
@@ -36,26 +41,53 @@ from jax.experimental.pallas import tpu as pltpu
 from ..quants import QK, FloatType, QTensor
 
 
-def _unpack_dot_epilogue(xexp_ref, sx_ref, wp_ref, s_ref, o_ref):
-    """Shared kernel body: split-plane unpack, per-half MXU dots, scale epilogue."""
+def _f16_bits_to_f32(h16):
+    """Exact f16-bit-pattern (int16) -> f32 decode using only int ops + one bitcast.
+
+    Mosaic cannot lower f16 refs, and the TPU VPU flushes subnormal f32 to zero, so
+    the usual magic-multiply half->float trick silently zeroes subnormal deltas.
+    Instead use  value = (m + (e>0)*1024) * 2^(max(e,1) - 25)  with the power of two
+    built by bitcasting (k+127)<<23: every intermediate is a normal f32, making the
+    decode bit-exact for all 65024 finite f16 patterns (verified exhaustively on a
+    real v5e chip; f16 inf/nan decode wrong but Q40 deltas are always finite)."""
+    h = h16.astype(jnp.int32) & 0xFFFF
+    e = (h >> 10) & 0x1F
+    mant = jnp.where(e > 0, (h & 0x3FF) + 1024, h & 0x3FF).astype(jnp.float32)
+    p2 = jax.lax.bitcast_convert_type((jnp.maximum(e, 1) + 102) << 23, jnp.float32)
+    f = mant * p2
+    return jnp.where((h & 0x8000) != 0, -f, f)
+
+
+def _unpack_dot_epilogue(xexp_ref, sx_ref, ssum_ref, wp_ref, s_ref, o_ref):
+    """Shared kernel body: split-plane unpack, per-half MXU dots, scale epilogue.
+
+    Mosaic on this toolchain cannot legalize elementwise subtract or logical shift on
+    i8/u8 vectors (arith.subi / arith.shrui), so (a) the high nibble's shift widens
+    through i32 (the only narrow-int ops Mosaic does lower are and/cast), and (b) the
+    nibble's +8 offset is NOT removed per weight: the unsigned nibbles q in [0,16) go
+    straight to the MXU and the offset folds into a per-block int32 correction:
+    (q-8)·x = q·x - 8·Σ_block(x)  with Σ_block(x) = ssum_ref (the Q80 activation
+    block sums, computed once per row outside the kernel). Same integer result
+    bit-for-bit as subtracting 8 per weight."""
     wp = wp_ref[:]  # (bn, K/2) uint8
-    lo = (wp & jnp.uint8(0x0F)).astype(jnp.int8) - 8  # elements [0, K/2)
-    hi = (wp >> 4).astype(jnp.int8) - 8  # elements [K/2, K)
+    lo = (wp & jnp.uint8(0x0F)).astype(jnp.int8)  # q of elements [0, K/2)
+    hi = (wp.astype(jnp.int32) >> 4).astype(jnp.int8)  # q of elements [K/2, K)
     kh = wp.shape[1]
-    # P[n, b] = sum_{j in block b} w8[n, j] * xq[j] — int8 x int8 -> int32 on the MXU
+    # P[n, b] = sum_{j in block b} q[n, j] * xq[j] — int8 x int8 -> int32 on the MXU
     p = jax.lax.dot_general(lo, xexp_ref[:kh], (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.int32)
     p += jax.lax.dot_general(hi, xexp_ref[kh:], (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.int32)
-    y = (s_ref[:].astype(jnp.float32) * sx_ref[:]) * p.astype(jnp.float32)
+    p -= ssum_ref[:] * 8  # remove the nibble offset per block (broadcast over rows)
+    y = (_f16_bits_to_f32(s_ref[:]) * sx_ref[:]) * p.astype(jnp.float32)
     o_ref[:] = jnp.sum(y, axis=1, keepdims=True)
 
 
-def _matvec_kernel(xexp_ref, sx_ref, wp_ref, s_ref, o_ref):
-    _unpack_dot_epilogue(xexp_ref, sx_ref, wp_ref, s_ref, o_ref)
+def _matvec_kernel(xexp_ref, sx_ref, ssum_ref, wp_ref, s_ref, o_ref):
+    _unpack_dot_epilogue(xexp_ref, sx_ref, ssum_ref, wp_ref, s_ref, o_ref)
 
 
-def _matvec_kernel_inline(xq_ref, sx_ref, wp_ref, s_ref, o_ref, xexp_ref):
+def _matvec_kernel_inline(xq_ref, sx_ref, ssum_ref, wp_ref, s_ref, o_ref, xexp_ref):
     """Variant generating the block-diagonal Xexp in VMEM scratch from the raw int8
     activation row (k bytes of HBM instead of k*nb): built once at grid step 0, reused
     by every row block."""
@@ -67,7 +99,7 @@ def _matvec_kernel_inline(xq_ref, sx_ref, wp_ref, s_ref, o_ref, xexp_ref):
 
         xexp_ref[:] = block_diag_scatter(xq_ref[0], nb)
 
-    _unpack_dot_epilogue(xexp_ref, sx_ref, wp_ref, s_ref, o_ref)
+    _unpack_dot_epilogue(xexp_ref, sx_ref, ssum_ref, wp_ref, s_ref, o_ref)
 
 
 def _pick_bn(n: int, k: int, budget_bytes: int = 3 << 20) -> int:
@@ -98,11 +130,14 @@ def q4_decode_supported(w: QTensor) -> bool:
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _q4_matvec(xexp, sx, wp, scales, *, interpret: bool = False):
     """y (n, 1) f32 from block-diagonal Xexp (K, nb) int8, sx (1, nb) f32,
-    packed nibbles (n, K/2) uint8, scales (n, nb) f16."""
+    packed nibbles (n, K/2) uint8, scales (n, nb) int16 f16-bit-patterns."""
     k, nb = xexp.shape
     n, kh = wp.shape
     assert kh * 2 == k and scales.shape == (n, nb) and nb * QK == k, (
         xexp.shape, wp.shape, scales.shape)
+    # activation block sums for the nibble-offset correction (colsum works because
+    # Xexp's column b is exactly block b's xq values scattered along its rows)
+    ssum = jnp.sum(xexp, axis=0, dtype=jnp.int32)[None, :]
     bn = _pick_bn(n, k)
     return pl.pallas_call(
         _matvec_kernel,
@@ -110,13 +145,14 @@ def _q4_matvec(xexp, sx, wp, scales, *, interpret: bool = False):
         in_specs=[
             pl.BlockSpec((k, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bn, kh), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bn, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
         interpret=interpret,
-    )(xexp, sx, wp, scales)
+    )(xexp, sx, ssum, wp, scales)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -127,12 +163,14 @@ def _q4_matvec_inline(xq, sx, wp, scales, *, interpret: bool = False):
     n, kh = wp.shape
     nb = k // QK
     assert kh * 2 == k and scales.shape == (n, nb), (xq.shape, wp.shape, scales.shape)
+    ssum = jnp.sum(xq.reshape(nb, QK), axis=1, dtype=jnp.int32)[None, :]
     bn = _pick_bn(n, k)
     return pl.pallas_call(
         _matvec_kernel_inline,
         grid=(pl.cdiv(n, bn),),
         in_specs=[
             pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bn, kh), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bn, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -141,7 +179,7 @@ def _q4_matvec_inline(xq, sx, wp, scales, *, interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
         scratch_shapes=[pltpu.VMEM((k, nb), jnp.int8)],
         interpret=interpret,
-    )(xq, sx, wp, scales)
+    )(xq, sx, ssum, wp, scales)
 
 
 # flip after measuring on hardware (perf/microbench.py --section matvec compares both)

@@ -121,10 +121,17 @@ def _quantize_row(x_row: jax.Array, nb: int):
 
 def block_diag_scatter(xq: jax.Array, nb: int) -> jax.Array:
     """Scatter a quantized row (K,) block-diagonally: Xexp[j, b] = xq[j] iff
-    j // QK == b. Pure jnp — usable both in XLA and inside Pallas kernel bodies."""
+    j // QK == b. Pure jnp — usable both in XLA and inside Pallas kernel bodies.
+
+    Sub-32-bit dtypes broadcast through i32: Mosaic cannot insert a minor dim on
+    narrow vectors ("Insertion of minor dim that is not a no-op only supported for
+    32-bit types"), so the int8 path widens for the where and narrows after."""
     k = xq.shape[0]
     block_of = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 0) // QK
     b_idx = jax.lax.broadcasted_iota(jnp.int32, (k, nb), 1)
+    if xq.dtype.itemsize < 4:
+        wide = jnp.where(block_of == b_idx, xq.astype(jnp.int32)[:, None], 0)
+        return wide.astype(xq.dtype)
     return jnp.where(block_of == b_idx, xq[:, None], jnp.zeros((), xq.dtype))
 
 

@@ -232,7 +232,8 @@ class QTensor:
 
     ftype: FloatType
     data: jax.Array | np.ndarray  # dense values, Q40 packed u8, or Q80 int8
-    scales: jax.Array | np.ndarray | None = None  # f16 per-block scales for Q40/Q80
+    # per-block scales for Q40/Q80: f16 (planar), f32 (i8), int16 f16-bit-patterns (i4p)
+    scales: jax.Array | np.ndarray | None = None
     # "planar" | "i8" (int8 planes, to_i8_layout) | "i4p" (split-plane packed nibbles,
     # to_i4p_layout — true Q40 HBM density for the pallas_q4 decode kernel)
     layout: str = "planar"
@@ -300,7 +301,10 @@ class QTensor:
     def to_i4p_layout(self, col_groups: int = 1) -> "QTensor":
         """Repack planar Q40 into split-plane nibbles for the 4-bit MXU matvec kernel
         (ops/pallas_q4.py): data uint8 (..., K/2) with byte j = q[j] | (q[j+K/2] << 4)
-        where q = nibble+8; scales f16 (..., K/32) kept bit-exact from the file.
+        where q = nibble+8; scales stored as int16 BIT PATTERNS of the file's f16
+        deltas (bit-exact, same 2 B/block) because Mosaic on this toolchain cannot
+        lower f16 refs — the kernel decodes f16-bits -> f32 with exact integer math
+        (pallas_q4._f16_bits_to_f32) and dequantize()/to_numpy() bitcast back.
 
         Both unpacked planes land in natural element order, so the kernel needs no
         cross-lane shuffles. Same HBM bytes as the reference's BlockQ40 stream
@@ -317,10 +321,11 @@ class QTensor:
         packed = np.asarray(self.data)  # (..., nb, 16)
         from . import native
 
+        scales16 = np.ascontiguousarray(
+            np.asarray(self.scales, dtype=np.float16)).view(np.int16)
         nat = native.q40_to_i4p(packed, col_groups)
         if nat is not None:
-            return QTensor(self.ftype, nat, np.asarray(self.scales, dtype=np.float16),
-                           layout="i4p", groups=col_groups)
+            return QTensor(self.ftype, nat, scales16, layout="i4p", groups=col_groups)
         lo = (packed & 0x0F).astype(np.uint8)  # block elements 0..15
         hi = (packed >> 4).astype(np.uint8)  # block elements 16..31
         q = np.concatenate([lo, hi], axis=-1)  # (..., nb, 32) natural order, in [0,16)
@@ -331,8 +336,7 @@ class QTensor:
         q = q.reshape(*lead, col_groups, kl)
         data = q[..., : kl // 2] | (q[..., kl // 2 :] << 4)
         data = data.reshape(*lead, k // 2)
-        return QTensor(self.ftype, data, np.asarray(self.scales, dtype=np.float16),
-                       layout="i4p", groups=col_groups)
+        return QTensor(self.ftype, data, scales16, layout="i4p", groups=col_groups)
 
     def _i4p_unpack(self, xp):
         """Split-plane nibbles -> natural-order values (..., K) minus the 8 offset."""
@@ -371,7 +375,8 @@ class QTensor:
             vals = self._i4p_unpack(jnp)
             nb = self.scales.shape[-1]
             g = vals.reshape(*vals.shape[:-1], nb, QK)
-            return jnp_dequantize_q80(g, jnp.asarray(self.scales), dtype)
+            scales = jax.lax.bitcast_convert_type(jnp.asarray(self.scales), jnp.float16)
+            return jnp_dequantize_q80(g, scales, dtype)
         if self.ftype == FloatType.Q40:
             return jnp_dequantize_q40(jnp.asarray(self.data), jnp.asarray(self.scales), dtype)
         if self.ftype == FloatType.Q80:
@@ -389,7 +394,7 @@ class QTensor:
             vals = self._i4p_unpack(np)
             nb = self.scales.shape[-1]
             g = vals.reshape(*vals.shape[:-1], nb, QK)
-            return dequantize_q80(g, np.asarray(self.scales))
+            return dequantize_q80(g, np.asarray(self.scales).view(np.float16))
         if self.ftype == FloatType.Q40:
             return dequantize_q40(np.asarray(self.data), np.asarray(self.scales))
         if self.ftype == FloatType.Q80:

@@ -27,7 +27,7 @@ def test_i4p_roundtrip_exact():
     rng = np.random.RandomState(3)
     w = QTensor.from_float(rng.randn(64, 256).astype(np.float32), FloatType.Q40)
     wi = w.to_i4p_layout()
-    assert wi.data.shape == (64, 128) and wi.scales.dtype == np.float16
+    assert wi.data.shape == (64, 128) and wi.scales.dtype == np.int16
     np.testing.assert_array_equal(wi.to_numpy(), w.to_numpy())
     np.testing.assert_allclose(np.asarray(wi.dequantize(jnp.float32)), w.to_numpy(),
                                atol=1e-6)
