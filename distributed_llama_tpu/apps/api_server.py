@@ -318,13 +318,14 @@ def main(argv=None) -> None:
         import jax.numpy as jnp
 
         from ..runtime.batch_engine import BatchEngine
-        from .dllama import _FT
+        from .dllama import _FT, init_pod
 
+        init_pod(args)
         batch_engine = BatchEngine.load(
             args.model, args.tokenizer, max_seq_len=args.max_seq_len,
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
-            slots=args.batch, tp=args.tp, dp=args.dp,
+            slots=args.batch, tp=args.tp, dp=args.dp, pod=args.pod,
             dtype=(None if args.dtype == "auto"
                    else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
             use_pallas=False if args.no_pallas else None,

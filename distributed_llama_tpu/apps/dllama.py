@@ -43,10 +43,28 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                    choices=["f32", "f16", "q40", "q80"])
     p.add_argument("--buffer-float-type", default="q80",
                    choices=["f32", "f16", "q40", "q80"],
-                   help="q80 enables int8-compressed collectives (wire compression)")
+                   help="q80 enables int8-compressed collectives (the reference's "
+                        "wire compression, tasks.cpp:96-135). Numerics are pinned by "
+                        "tests and perf/microbench.py --section collectives; its TIME "
+                        "on real multi-chip ICI is UNMEASURED (no multi-chip hardware "
+                        "available) — expected to matter across DCN, likely a wash "
+                        "on ICI")
     p.add_argument("--tp", type=int, default=None, help="tensor-parallel devices")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel devices (ring attention over the KV cache)")
+    p.add_argument("--pod", action="store_true",
+                   help="join a multi-host pod job via jax.distributed and mesh over "
+                        "every chip in the job — the SPMD replacement for the "
+                        "reference's `dllama worker` + --workers bootstrap "
+                        "(dllama.cpp:205-221). On Cloud TPU the coordinator is "
+                        "auto-discovered; elsewhere pass --coordinator/--num-processes/"
+                        "--process-id. Run the SAME command on every host.")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator for --pod off Cloud TPU")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total processes in the --pod job (off Cloud TPU)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's index in the --pod job (off Cloud TPU)")
     p.add_argument("--dtype", default="auto", choices=["auto", "float32", "bfloat16"],
                    help="auto = bfloat16 on TPU, float32 on CPU")
     p.add_argument("--no-pallas", action="store_true")
@@ -80,15 +98,33 @@ _FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
        "q80": FloatType.Q80}
 
 
+def init_pod(args) -> int:
+    """--pod bootstrap: join the jax.distributed job before any device use.
+    Returns this host's process index (0 when not a pod job)."""
+    if not getattr(args, "pod", False):
+        return 0
+    from ..parallel.mesh import init_multihost
+
+    idx = init_multihost(coordinator=args.coordinator,
+                         num_processes=args.num_processes,
+                         process_id=args.process_id)
+    import jax
+
+    print(f"🌐 Pod process {idx}/{jax.process_count()}: "
+          f"{jax.local_device_count()} local / {jax.device_count()} global chips")
+    return idx
+
+
 def make_engine(args) -> Engine:
     import jax.numpy as jnp
     import time
 
+    init_pod(args)
     t0 = time.perf_counter()
     engine = Engine.load(
         args.model, args.tokenizer, max_seq_len=args.max_seq_len,
         weights_ftype=_FT[args.weights_float_type] if args.weights_float_type else None,
-        tp=args.tp, sp=args.sp,
+        tp=args.tp, sp=args.sp, pod=getattr(args, "pod", False),
         dtype=(None if args.dtype == "auto"
                else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
         use_pallas=False if args.no_pallas else None,

@@ -59,30 +59,37 @@ def make_pod_mesh(tp: int | None = None, sp: int = 1, dp: int | None = None) -> 
 
     Axis placement follows the bandwidth hierarchy: tp (all-reduce per layer —
     the heaviest traffic, tasks.cpp:44-94's broadcast/gather pattern) and sp
-    (ring permutes) stay INSIDE a slice on ICI; dp (independent sequences, no
-    per-step traffic) spans hosts over DCN. This is the standard
+    (ring permutes) stay INSIDE an ICI domain; dp (independent sequences, no
+    per-step traffic) spans ICI domains over DCN. This is the standard
     ici/dcn hybrid-mesh recipe; the reference's 1 GbE star forced ALL traffic
     over the slow link, which is why its 8-node numbers collapse
     (reference README.md:122).
+
+    The ICI domain is a pod SLICE, not a host: on a v5p-16 (4 hosts, one slice)
+    every chip is ICI-connected, so tp=16 across all 4 hosts is the right layout
+    — the BASELINE.json 405B north-star config. Only MULTISLICE jobs (devices
+    reporting distinct slice_index) have a DCN boundary, and there dp must span
+    the slices.
     """
     from jax.experimental import mesh_utils
 
-    n_local = jax.local_device_count()
-    n_proc = jax.process_count()
-    n_total = n_local * n_proc
+    devs = jax.devices()  # global: every chip in the job, all processes
+    n_total = len(devs)
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
     if tp is None:
-        dp = dp if dp is not None else n_proc
+        dp = dp if dp is not None else n_slices
         assert n_total % (dp * sp) == 0, (n_total, dp, sp)
         tp = n_total // (dp * sp)
     elif dp is None:
         assert n_total % (sp * tp) == 0, (n_total, sp, tp)
         dp = n_total // (sp * tp)
-    assert dp * sp * tp == n_total, (dp, sp, tp, n_local, n_proc)
-    if n_proc == 1:
-        return make_mesh(tp=tp, sp=sp, dp=dp)
-    assert dp % n_proc == 0, (
-        f"dp={dp} must span the {n_proc} hosts (tp/sp must fit inside one slice: "
-        f"{sp * tp} chips vs {n_local} local)")
-    devs = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(dp // n_proc, sp, tp), dcn_mesh_shape=(n_proc, 1, 1))
-    return Mesh(devs, (AXIS_DP, AXIS_SP, AXIS_TP))
+    assert dp * sp * tp == n_total, (dp, sp, tp, n_total)
+    if n_slices == 1:
+        # one ICI domain (single- or multi-host): any (dp, sp, tp) layout works
+        return make_mesh(tp=tp, sp=sp, dp=dp, devices=devs)
+    assert dp % n_slices == 0, (
+        f"dp={dp} must span the {n_slices} slices (tp/sp must fit inside one "
+        f"slice: {sp * tp} chips vs {n_total // n_slices} per slice)")
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(dp // n_slices, sp, tp), dcn_mesh_shape=(n_slices, 1, 1))
+    return Mesh(grid, (AXIS_DP, AXIS_SP, AXIS_TP))

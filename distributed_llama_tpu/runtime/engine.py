@@ -83,7 +83,8 @@ class Engine:
     def __init__(self, spec: ModelSpec, params: Params, tokenizer: Tokenizer | None = None,
                  *, tp: int | None = None, sp: int = 1, dp: int = 1, dtype=None,
                  use_pallas: bool | None = None,
-                 compress_collectives: bool = False, batch: int = 1):
+                 compress_collectives: bool = False, batch: int = 1,
+                 pod: bool = False):
         self.spec = spec
         self.tokenizer = tokenizer
         on_tpu = jax.default_backend() == "tpu"
@@ -95,10 +96,22 @@ class Engine:
         self.compress = compress_collectives
         if use_pallas is None:
             use_pallas = on_tpu
+        if pod:
+            # multi-host job: mesh over EVERY chip in the job (the SPMD replacement
+            # for the reference's worker fleet, dllama.cpp:205-221). Caller must have
+            # run init_multihost() first so jax.devices() is global.
+            from ..parallel.mesh import make_pod_mesh
+
+            self.mesh = make_pod_mesh(tp=tp, sp=sp,
+                                      dp=dp if dp > 1 else None)
+            from ..parallel.mesh import AXIS_DP
+
+            dp = self.mesh.shape[AXIS_DP]
+        else:
+            self.mesh = make_mesh(tp=tp, sp=sp, dp=dp)
         assert batch % dp == 0, (
             f"batch={batch} must divide over dp={dp} (each dp shard holds "
             "batch/dp cache rows)")
-        self.mesh = make_mesh(tp=tp, sp=sp, dp=dp)
         self.tp = self.mesh.shape[AXIS_TP]
         self.sp = sp
         self.dp = dp

@@ -145,17 +145,55 @@ def sec_attention(reps):
              cache_gb=round(gb, 3), gbps=round(gb / dt, 1))
 
 
+def sec_collectives(reps):
+    """quantized_psum (Q80-compressed all-reduce, the reference's wire compression
+    tasks.cpp:96-135) vs plain psum: numerics always; time only as a relative number
+    on whatever mesh is available. One real chip has no ICI, so run this section
+    under the virtual CPU mesh (JAX_PLATFORMS=cpu
+    XLA_FLAGS=--xla_force_host_platform_device_count=8) for an 8-way ring; the
+    wall-clock there measures the EXTRA COMPUTE of quantize/dequantize, not wire
+    time — labeled mesh="cpu" so nobody mistakes it for an ICI measurement."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_llama_tpu.parallel.collectives import psum, quantized_psum
+    from distributed_llama_tpu.parallel.mesh import AXIS_TP, make_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        emit(section="collectives", skipped=f"need >=2 devices, have {n_dev}",
+             note="run under the 8-device virtual CPU mesh for numerics/compute cost")
+        return
+    mesh = make_mesh(tp=n_dev)
+    dim = 4096
+    rng = np.random.RandomState(0)
+    parts = rng.randn(n_dev, dim).astype(np.float32) * 0.1
+    x = jax.device_put(jnp.asarray(parts), NamedSharding(mesh, P(AXIS_TP)))
+    want = parts.sum(0)
+
+    for name, fn in (("psum", psum), ("quantized_psum",
+                                      lambda v, ax: quantized_psum(v, ax))):
+        g = jax.jit(jax.shard_map(lambda v: fn(v, AXIS_TP), mesh=mesh,
+                                  in_specs=P(AXIS_TP), out_specs=P(AXIS_TP)))
+        out = np.asarray(jax.device_get(g(x).addressable_shards[0].data))[0]
+        rel = float(np.abs(out - want).max() / (np.abs(want).max() + 1e-9))
+        dt = timed(g, x, reps=reps)
+        emit(section="collectives", op=name, mesh=jax.default_backend(),
+             n_dev=n_dev, dim=dim, rel_err=round(rel, 6), ms=round(dt * 1e3, 3))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--section", default=None,
-                    choices=["dispatch", "stream", "matvec", "attention"])
+                    choices=["dispatch", "stream", "matvec", "attention",
+                             "collectives"])
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
     reps = 3 if args.quick else 10
     emit(section="meta", backend=jax.default_backend(),
          device=str(jax.devices()[0]))
     secs = {"dispatch": sec_dispatch, "stream": sec_stream, "matvec": sec_matvec,
-            "attention": sec_attention}
+            "attention": sec_attention, "collectives": sec_collectives}
     for name, fn in secs.items():
         if args.section in (None, name):
             fn(reps)

@@ -1,19 +1,44 @@
 #!/bin/bash
 # One-shot hardware measurement sweep — run on a live TPU chip to collect every
-# pending A/B from the round-3 redesign (see perf/PROFILE.md). Each line is a JSON
-# record; tee everything into perf/sweep_results.jsonl for analysis.
+# pending A/B (see perf/PROFILE.md). Each line is a JSON record; tee everything
+# into perf/sweep_results.jsonl for analysis.
 #
 #   bash perf/sweep.sh [outfile]
-set -e
+#
+# Every emitted line is valid JSON (command markers are {"section":"cmd",...}
+# records, not '#' comments), and a command that dies still leaves an explicit
+# {"section":"error",...} record instead of silently vanishing from the file.
+set -e -o pipefail
 cd "$(dirname "$0")/.."
 OUT="${1:-perf/sweep_results.jsonl}"
 : > "$OUT"
 
-run() { echo "# $*" | tee -a "$OUT"; "$@" 2>/dev/null | tail -1 | tee -a "$OUT"; }
+run() {
+    python - "$*" <<'PY' | tee -a "$OUT"
+import json, sys
+print(json.dumps({"section": "cmd", "argv": sys.argv[1]}))
+PY
+    local line
+    if line=$("$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
+        echo "$line" | tee -a "$OUT"
+    else
+        python - "$*" <<'PY' | tee -a "$OUT"
+import json, sys
+print(json.dumps({"section": "error", "argv": sys.argv[1],
+                  "error": "command failed or produced no output"}))
+PY
+    fi
+}
 
 # platform characteristics (dispatch overhead, streaming ceiling, kernel GB/s,
 # windowed-vs-full attention) — includes the i4p vs i4p-inline vs i8 kernel A/B
 python perf/microbench.py | tee -a "$OUT"
+
+# quantized_psum numerics + quantize/dequant compute cost on the 8-way virtual CPU
+# mesh (one real chip has no ICI; the record carries mesh=cpu so it cannot be
+# mistaken for an ICI time)
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python perf/microbench.py --section collectives | tee -a "$OUT"
 
 # headline decode: 4-bit kernel, windowed attention, host loop
 run python bench.py --steps 64
