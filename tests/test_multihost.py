@@ -68,7 +68,10 @@ def test_two_process_pod_bootstrap(tmp_path):
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=2")
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # PYTHONPATH is repo_root ONLY: launch environments (axon) preload a
+    # sitecustomize that imports jax at interpreter start, and a pre-initialized
+    # backend makes jax.distributed.initialize hang in the child.
+    env["PYTHONPATH"] = repo_root
     env.pop("PYTHONWARNINGS", None)
     procs = [
         subprocess.Popen([sys.executable, str(worker), coord, "2", str(i)],
