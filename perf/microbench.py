@@ -75,8 +75,17 @@ def sec_dispatch(reps):
 
 
 def sec_stream(reps):
-    for dt_, name, bpe in ((jnp.bfloat16, "bf16", 2), (jnp.int8, "int8", 1)):
-        L, n, k = 32, 11008, 4096
+    """Steady-state HBM read bandwidth, two probes per dtype family:
+
+    - matvec probes (bf16/int8 dot per scanned layer): what a DECODE layer attains,
+      including the dot's lowering cost. Round 3 published the int8 number (87-173
+      GB/s) as if it were bandwidth — it is not: XLA's int8 matvec lowering is
+      compute-bound, which this section now makes explicit by...
+    - raw probes (bitcast to i32 lanes, reduce): pure read bandwidth with a trivial
+      VPU reduction — the actual streaming ceiling for that operand size.
+    """
+    L, n, k = 32, 11008, 4096
+    for dt_, name, bpe in ((jnp.bfloat16, "bf16_matvec", 2), (jnp.int8, "int8_matvec", 1)):
         w = jnp.ones((L, n, k), dt_)
         x = jnp.ones((k,), jnp.bfloat16)
 
@@ -93,6 +102,21 @@ def sec_stream(reps):
         gb = L * n * k * bpe / 1e9
         emit(section="stream", dtype=name, gb=round(gb, 2), ms=round(dt * 1e3, 2),
              gbps=round(gb / dt, 1))
+    for src, name in ((jnp.bfloat16, "bf16_raw"), (jnp.int8, "int8_raw"),
+                      (jnp.uint8, "uint8_raw")):
+        lanes = 4 // jnp.dtype(src).itemsize
+        w = jnp.ones((L, n, k), src)
+
+        def body_raw(c, wl, lanes=lanes):
+            as_i32 = jax.lax.bitcast_convert_type(
+                wl.reshape(n, k // lanes, lanes), jnp.int32)
+            return c + jnp.sum(as_i32, dtype=jnp.int32).astype(jnp.float32), None
+
+        g = jax.jit(lambda w: jax.lax.scan(body_raw, jnp.float32(0), w)[0])
+        dt = timed(g, w, reps=reps)
+        gb = w.nbytes / 1e9
+        emit(section="stream", dtype=name, gb=round(gb, 2), ms=round(dt * 1e3, 2),
+             gbps=round(gb / dt, 1))
 
 
 def _rand_q40(n, k, seed=0):
@@ -102,7 +126,8 @@ def _rand_q40(n, k, seed=0):
 
 
 def sec_matvec(reps):
-    """q4 vs q8 kernels on the 7B hot shapes, amortized over a scan of L layers."""
+    """q4 vs q8 decode kernels on the 7B hot shapes (single dispatch per call;
+    the async chain in timed() amortizes dispatch overhead)."""
     on_tpu = jax.default_backend() == "tpu"
     shapes = [(4096, 4096), (11008, 4096), (4096, 11008), (32000, 4096)]
     for n, k in shapes:
