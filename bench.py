@@ -216,6 +216,13 @@ def main():
         state.update(params=params, layout=lay,
                      wbytes=decode_stream_bytes(params, spec))
         kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
+        if lay == "i4p" and os.environ.get("DLT_FORCE_I4P_FAILURE"):
+            # fallback-path drill: fail AFTER the full i4p set + caches occupy HBM,
+            # exactly like a real lowering failure — proves the except-path drops
+            # every reference (incl. the traceback's frames, which pinned ~4 GB in
+            # round 3) before the i8 rebuild. Run on hardware:
+            #   DLT_FORCE_I4P_FAILURE=1 python bench.py --steps 4
+            raise RuntimeError("forced i4p failure (DLT_FORCE_I4P_FAILURE drill)")
         return params, kc, vc
 
     def compile_with_fallback(make_and_warm):
