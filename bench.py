@@ -174,6 +174,12 @@ def main():
     ap.add_argument("--steps", type=int, default=32)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--layout", choices=("i4p", "i8"), default="i4p")
+    ap.add_argument("--cache-write", choices=("inscan", "deferred"), default="deferred",
+                    help="KV cache discipline: 'inscan' carries the caches through "
+                         "the layer scan with per-layer in-place updates; 'deferred' "
+                         "keeps them loop-invariant and commits all layers' new rows "
+                         "in one top-level write (kills the carry copies the round-4 "
+                         "trace found)")
     ap.add_argument("--window", type=int, default=256,
                     help="attention window bucket (cache positions decode reads)")
     ap.add_argument("--device-loop", type=int, default=0, metavar="N",
@@ -281,7 +287,8 @@ def main():
         def warm_prefill(params, kc, vc):
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
                                         use_pallas=on_tpu, donate_cache=True,
-                                        attn_window=pwindow)
+                                        attn_window=pwindow,
+                                        cache_write=args.cache_write)
             logits, kc, vc = step(params, rope, toks, kc, vc, jnp.int32(0))  # compile
             np.asarray(logits[0, 0, 0])
             return step, params, kc, vc
@@ -316,7 +323,8 @@ def main():
         def warm_loop(params, kc, vc):
             loop = make_decode_loop(spec, mesh, params, chunk, mode="greedy",
                                     dtype=dtype, use_pallas=on_tpu,
-                                    attn_window=window)
+                                    attn_window=window,
+                                    cache_write=args.cache_write)
             toks, _, kc, vc = loop(params, rope, 1, kc, vc, 0, key)  # compile + warm
             np.asarray(toks)
             return loop, params, kc, vc
@@ -335,7 +343,8 @@ def main():
         def warm_step(params, kc, vc):
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
                                         use_pallas=on_tpu, donate_cache=True,
-                                        attn_window=window)
+                                        attn_window=window,
+                                        cache_write=args.cache_write)
             logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile
             np.asarray(logits[0, 0, 0])
             return step, params, kc, vc

@@ -68,6 +68,13 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="auto", choices=["auto", "float32", "bfloat16"],
                    help="auto = bfloat16 on TPU, float32 on CPU")
     p.add_argument("--no-pallas", action="store_true")
+    p.add_argument("--cache-write", default="deferred",
+                   choices=["deferred", "inscan"],
+                   help="KV cache discipline (models/forward.py): 'deferred' keeps "
+                        "the caches loop-invariant in the layer scan and commits new "
+                        "rows in one top-level write (avoids XLA TPU's whole-cache "
+                        "carry copies); 'inscan' is the per-layer in-place form "
+                        "(automatic under --sp)")
     p.add_argument("--device-loop", type=int, default=0, metavar="CHUNK",
                    help="decode CHUNK tokens per dispatch with the on-device scan loop "
                         "(runtime/device_loop.py); 0 = per-token host loop")
@@ -129,6 +136,7 @@ def make_engine(args) -> Engine:
                else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
         use_pallas=False if args.no_pallas else None,
         compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1,
+        cache_write=args.cache_write,
     )
     print(f"⏩ Loaded model in {time.perf_counter() - t0:.1f}s "
           f"(tp={engine.tp}, pallas={engine.use_pallas})")

@@ -79,7 +79,7 @@ def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> 
 
 def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos,
                positions, axis_name, sp_axis_name, sp_size, use_pallas, compress,
-               window):
+               window, deferred_write=False):
     """Sharded attention sub-block against the FULL stacked caches (L, B, hk, S, hs).
 
     Head counts in bp may be TP-local slices; the cache sequence axis may be sp-sharded
@@ -116,6 +116,40 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
                              axis_size=sp_size)
         kc = jax.lax.dynamic_update_slice(kc, kl[None], (layer_idx, 0, 0, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, vl[None], (layer_idx, 0, 0, 0, 0))
+    elif deferred_write:
+        # deferred-write path: the caches are loop-INVARIANT inside the layer scan —
+        # attention reads the window of COMMITTED rows (positions < start_pos) and
+        # attends to the current chunk's k/v directly from registers; the new rows
+        # ride out of the scan as stacked ys and forward() commits all layers with
+        # ONE top-level dynamic_update_slice per cache. Motivation: a scan carry
+        # that is dynamic-update-sliced at a loop-varying layer index defeats XLA
+        # TPU's in-place while-loop buffer optimization — the round-4 trace shows
+        # the full (L,B,hk,S,hs) caches being copied at the step boundary
+        # (~11.6 ms/token at 7B, a third of the step). A read-only operand has no
+        # copy-on-write hazard.
+        k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)  # (B, hk, T, hs)
+        v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
+        win = window or s
+        kw = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
+        vw = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
+        # window slot j holds a committed row iff j < start_pos; stale slots get a
+        # past-seq_len position so the causal compare masks them. Current-chunk keys
+        # carry their true absolute positions.
+        slot = jnp.arange(win)
+        if start_pos.ndim == 0:
+            slot_pos = jnp.where(slot < start_pos, slot, s + 1)  # (win,)
+            key_pos = jnp.concatenate([slot_pos, start_pos + jnp.arange(t)])
+        else:  # per-row offsets (continuous batching)
+            slot_pos = jnp.where(slot[None, :] < start_pos[:, None], slot[None, :],
+                                 s + 1)  # (B, win)
+            key_pos = jnp.concatenate(
+                [slot_pos, start_pos[:, None] + jnp.arange(t)[None, :]], axis=1)
+        kfull = jnp.concatenate([kw, k_t], axis=2)  # (B, hk, win+T, hs)
+        vfull = jnp.concatenate([vw, v_t], axis=2)
+        att = gqa_attention(q, kfull, vfull, positions, key_positions=key_pos)
+        attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas),
+                               axis_name, compress)
+        return attn_out, (k_t, v_t)  # new rows only; caller commits post-scan
     elif start_pos.ndim == 1:
         # per-row offsets (continuous batching): vmap'd per-row write on the layer
         # slice, then full-layer write-back
@@ -127,7 +161,7 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         kc = jax.lax.dynamic_update_slice(kc, kl[None], (layer_idx, 0, 0, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, vl[None], (layer_idx, 0, 0, 0, 0))
     else:
-        # common path: tiny in-place write at (layer, :, :, pos), windowed read
+        # in-scan path: tiny in-place write at (layer, :, :, pos), windowed read
         k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)[None]  # (1, B, hk, T, hs)
         v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)[None]
         kc = jax.lax.dynamic_update_slice(kc, k_t, (layer_idx, 0, 0, start_pos, 0))
@@ -138,7 +172,7 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         att = gqa_attention(q, kw, vw, positions)
     # col-parallel wo: local heads x local input slice -> partial (B, T, dim); psum merges
     attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas), axis_name, compress)
-    return attn_out, kc, vc
+    return attn_out, (kc, vc)
 
 
 def _dense_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
@@ -217,12 +251,28 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
 
 
 def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions,
-           axis_name, sp_axis_name, sp_size, use_pallas, compress, window):
-    x, kc, vc = carry
+           axis_name, sp_axis_name, sp_size, use_pallas, compress, window,
+           kc_ro=None, vc_ro=None):
+    """One transformer block as a scan step. Two cache disciplines:
+
+    - in-scan (kc_ro is None): caches travel in the carry and are updated in place
+      per layer — carry (x, kc, vc), ys None.
+    - deferred (kc_ro/vc_ro set): caches are read-only closures (loop invariants);
+      carry is just x and the layer's new K/V rows leave as ys for forward() to
+      commit in one top-level write.
+    """
+    deferred = kc_ro is not None
+    if deferred:
+        x, kc, vc = carry, kc_ro, vc_ro
+    else:
+        x, kc, vc = carry
     bp, layer_idx = layer
-    attn_out, kc, vc = _attention(x, bp, layer_idx, spec, rope, kc, vc, start_pos,
-                                  positions, axis_name, sp_axis_name, sp_size,
-                                  use_pallas, compress, window)
+    attn_out, kvout = _attention(x, bp, layer_idx, spec, rope, kc, vc, start_pos,
+                                 positions, axis_name, sp_axis_name, sp_size,
+                                 use_pallas, compress, window,
+                                 deferred_write=deferred)
+    if not deferred:
+        kc, vc = kvout
     if spec.arch_type == ArchType.GROK1:
         # grok: residual-join the *normalized* attention output (grokRmfFfn/Norm/Join)
         x = x + rmsnorm(attn_out, bp["rms_ffn"], spec.norm_eps)
@@ -236,6 +286,8 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
             x = x + _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
         else:
             x = x + _dense_ffn(xb, bp, spec, axis_name, use_pallas, compress)
+    if deferred:
+        return x, kvout  # ys: this layer's (k_t, v_t) new rows
     return (x, kc, vc), None
 
 
@@ -244,7 +296,7 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             start_pos: jax.Array, *, dtype=jnp.float32, axis_name: str | None = None,
             sp_axis_name: str | None = None, sp_size: int = 1,
             use_pallas: bool = False, compress_collectives: bool = False,
-            attn_window: int | None = None):
+            attn_window: int | None = None, cache_write: str = "inscan"):
     """Run T tokens through the model against the KV cache.
 
     tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar
@@ -252,9 +304,19 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
     (continuous batching: each sequence decodes at its own position; the reference's
     single-slot pos has no analog). Returns (logits (B, T, vocab) f32, caches).
 
-    The caches are scan CARRIES, updated in place per layer at a dynamic layer index —
-    NOT scan xs/ys, which would restack (read+write) the full (L, B, hk, S, hs) buffers
-    every step (~4 GB/token at 7B/2048, measured as half the step time in round 3).
+    cache_write selects the cache discipline:
+    - "inscan": caches are scan CARRIES, updated in place per layer at a dynamic
+      layer index — NOT scan xs/ys, which would restack (read+write) the full
+      (L, B, hk, S, hs) buffers every step (~4 GB/token at 7B/2048, measured as
+      half the step time in round 3).
+    - "deferred": caches are loop-INVARIANT operands of the scan (read-only);
+      each layer's new K/V rows leave as ys ((L, B, hk, T, hs), tiny) and ONE
+      top-level dynamic_update_slice per cache commits them after the scan.
+      Motivation: the round-4 TPU trace shows the in-scan carries being copied
+      whole at the step boundary (~11.6 ms/token at 7B) — XLA TPU's in-place
+      while-buffer optimization does not fire for a carry that is
+      dynamic-update-sliced at a loop-varying index. Not supported with sp
+      (ring attention keeps its own full-slice update).
 
     attn_window: static bound on cache positions attention reads (must cover
     start_pos + T). None reads the full seq_len. Callers bucket it (Engine) so decode
@@ -276,14 +338,36 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
     if spec.arch_type == ArchType.GROK1:
         x = x * GROK_EMBEDDING_SCALE
 
+    assert cache_write in ("inscan", "deferred"), cache_write
+    deferred = cache_write == "deferred" and not (
+        sp_axis_name is not None and sp_size > 1)
     block_fn = functools.partial(_block, spec=spec, rope=rope, start_pos=start_pos,
                                  positions=positions, axis_name=axis_name,
                                  sp_axis_name=sp_axis_name, sp_size=sp_size,
                                  use_pallas=use_pallas, compress=compress_collectives,
-                                 window=attn_window)
+                                 window=attn_window,
+                                 kc_ro=k_cache if deferred else None,
+                                 vc_ro=v_cache if deferred else None)
     layer_ids = jnp.arange(spec.n_layers, dtype=jnp.int32)
-    (x, k_cache, v_cache), _ = jax.lax.scan(
-        block_fn, (x, k_cache, v_cache), (params["blocks"], layer_ids))
+    if deferred:
+        x, (k_rows, v_rows) = jax.lax.scan(
+            block_fn, x, (params["blocks"], layer_ids))
+        # commit all layers' new rows in one write per cache: (L, B, hk, T, hs)
+        # lands at [.., .., .., start_pos : start_pos+T, ..]
+        if start_pos.ndim == 0:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k_rows, (0, 0, 0, start_pos, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v_rows, (0, 0, 0, start_pos, 0))
+        else:  # per-row offsets: vmap the write over the batch axis
+            row_write = jax.vmap(
+                lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, 0, p, 0)),
+                in_axes=(1, 1, 0), out_axes=1)
+            k_cache = row_write(k_cache, k_rows, start_pos)
+            v_cache = row_write(v_cache, v_rows, start_pos)
+    else:
+        (x, k_cache, v_cache), _ = jax.lax.scan(
+            block_fn, (x, k_cache, v_cache), (params["blocks"], layer_ids))
 
     x = rmsnorm(x, params["rms_final"], spec.norm_eps)
     logits = qmatmul(x, params["wcls"], use_pallas=use_pallas, out_dtype=jnp.float32)

@@ -23,11 +23,16 @@ from .kernels import masked_softmax
 
 
 def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
-                  positions: jax.Array) -> jax.Array:
+                  positions: jax.Array,
+                  key_positions: jax.Array | None = None) -> jax.Array:
     """Causal GQA attention of T query tokens against the full cache.
 
     positions: absolute query positions, (T,) shared across the batch or (B, T)
     per-row (continuous batching: each batch row decodes at its own offset).
+    key_positions: absolute position of each key slot, (S,) or per-row (B, S).
+    Defaults to arange(S) (slot index == position, the resident-cache layout);
+    the deferred-cache-write path passes [window slots ++ current-chunk positions]
+    with garbage slots pushed past seq_len so the causal compare masks them.
     Returns (B, T, n_q_heads * hs)."""
     b, t, hq, hs = q.shape
     _, hk, s, _ = k_cache.shape
@@ -37,11 +42,15 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     # (B, hk, g, T, S)
     scores = jnp.einsum("btkgd,bksd->bkgts", qg.astype(jnp.float32),
                         k_cache.astype(jnp.float32)) * scale
+    if key_positions is None:
+        key_positions = jnp.arange(s)
     if positions.ndim == 1:
-        valid = jnp.arange(s)[None, :] <= positions[:, None]  # (T, S) causal mask
+        assert key_positions.ndim == 1
+        valid = key_positions[None, :] <= positions[:, None]  # (T, S) causal mask
         mask = valid[None, None, None, :, :]
     else:
-        valid = jnp.arange(s)[None, None, :] <= positions[:, :, None]  # (B, T, S)
+        kp = key_positions if key_positions.ndim == 2 else key_positions[None, :]
+        valid = kp[:, None, :] <= positions[:, :, None]  # (B, T, S)
         mask = valid[:, None, None, :, :]
     probs = masked_softmax(scores, mask)
     out = jnp.einsum("bkgts,bksd->btkgd", probs, v_cache.astype(jnp.float32))

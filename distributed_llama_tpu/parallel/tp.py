@@ -111,7 +111,8 @@ def init_sharded_kv_cache(spec: ModelSpec, mesh: Mesh, batch: int = 1, dtype=Non
 def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
                          dtype=None, use_pallas: bool = False,
                          compress_collectives: bool = False, donate_cache: bool = True,
-                         attn_window: int | None = None):
+                         attn_window: int | None = None,
+                         cache_write: str = "inscan"):
     """Build the jitted SPMD forward step over the mesh's tp axis.
 
     Returns fn(params, rope, tokens, k_cache, v_cache, start_pos) ->
@@ -145,7 +146,7 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
-                            attn_window=attn_window)
+                            attn_window=attn_window, cache_write=cache_write)
     rope_type = spec.rope_type
 
     def step(p, rope_cos, rope_sin, tokens, kc, vc, start_pos):

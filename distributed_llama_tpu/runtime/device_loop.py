@@ -84,7 +84,7 @@ def device_sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
 def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str = "greedy",
                      dtype=None, use_pallas: bool = False,
                      compress_collectives: bool = False, donate_cache: bool = True,
-                     attn_window: int | None = None):
+                     attn_window: int | None = None, cache_write: str = "inscan"):
     """Build fn(params, rope, token, kc, vc, start_pos, key, temperature, topp) ->
     (tokens (n_steps,), last_logits (vocab,), kc, vc).
 
@@ -108,7 +108,7 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
-                            attn_window=attn_window)
+                            attn_window=attn_window, cache_write=cache_write)
 
     def loop(p, rope_cos, rope_sin, token, kc, vc, start_pos, key, temperature, topp):
         rope = RopeTables(rope_cos, rope_sin, rope_type)

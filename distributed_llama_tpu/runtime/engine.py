@@ -84,7 +84,7 @@ class Engine:
                  *, tp: int | None = None, sp: int = 1, dp: int = 1, dtype=None,
                  use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1,
-                 pod: bool = False):
+                 pod: bool = False, cache_write: str = "deferred"):
         self.spec = spec
         self.tokenizer = tokenizer
         on_tpu = jax.default_backend() == "tpu"
@@ -115,6 +115,12 @@ class Engine:
         self.tp = self.mesh.shape[AXIS_TP]
         self.sp = sp
         self.dp = dp
+        # KV cache discipline (models/forward.py): "deferred" keeps the caches
+        # loop-invariant in the layer scan — avoids the whole-cache carry copies
+        # XLA TPU inserts for dynamically-indexed carry updates (round-4 trace:
+        # ~11.6 ms/token at 7B). "inscan" is the per-layer in-place form (required
+        # with sp: ring attention owns its cache update).
+        self.cache_write = "inscan" if sp > 1 else cache_write
         has_quant = any(
             getattr(t, "ftype", None) in (FloatType.Q40, FloatType.Q80)
             for t in params["blocks"].values())
@@ -155,7 +161,8 @@ class Engine:
             self._steps[window] = make_sharded_forward(
                 self.spec, self.mesh, self.params, dtype=self.dtype,
                 use_pallas=self.use_pallas, compress_collectives=self.compress,
-                donate_cache=True, attn_window=window)
+                donate_cache=True, attn_window=window,
+                cache_write=self.cache_write)
         return self._steps[window]
 
     @property
@@ -337,7 +344,7 @@ class Engine:
                 self.spec, self.mesh, self.params, chunk, mode=mode, dtype=self.dtype,
                 use_pallas=self.use_pallas,
                 compress_collectives=self.compress, donate_cache=True,
-                attn_window=window)
+                attn_window=window, cache_write=self.cache_write)
         return self._decode_loops[chunk, mode, window]
 
     def _loop_traffic(self, chunk: int, mode: str, loop):
