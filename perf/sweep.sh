@@ -46,6 +46,11 @@ run python bench.py --steps 64
 # kernel layout A/B at the model level
 run python bench.py --steps 64 --layout i8
 
+# cache-write discipline A/B (deferred = default; inscan carries the caches
+# through the layer scan — the round-4 trace blamed its carry copies for a
+# third of the step)
+run python bench.py --steps 64 --cache-write inscan
+
 # window sweep: growing live-context cost (watchdog grows the bucket as needed)
 run python bench.py --steps 64 --window 2048
 
