@@ -85,8 +85,16 @@ def make_pod_mesh(tp: int | None = None, sp: int = 1, dp: int | None = None) -> 
         dp = n_total // (sp * tp)
     assert dp * sp * tp == n_total, (dp, sp, tp, n_total)
     if n_slices == 1:
-        # one ICI domain (single- or multi-host): any (dp, sp, tp) layout works
-        return make_mesh(tp=tp, sp=sp, dp=dp, devices=devs)
+        # one ICI domain (single- or multi-host). create_device_mesh reorders the
+        # devices so mesh neighbors are torus neighbors — raw jax.devices()
+        # enumeration order would let the per-layer all-reduce ring cross the ICI
+        # torus non-contiguously on multi-host slices (e.g. v5p-16 tp=16).
+        try:
+            grid = mesh_utils.create_device_mesh((dp, sp, tp), devices=devs)
+            return Mesh(grid, (AXIS_DP, AXIS_SP, AXIS_TP))
+        except (ValueError, NotImplementedError, AssertionError):
+            # non-TPU platforms / shapes create_device_mesh cannot map: plain order
+            return make_mesh(tp=tp, sp=sp, dp=dp, devices=devs)
     assert dp % n_slices == 0, (
         f"dp={dp} must span the {n_slices} slices (tp/sp must fit inside one "
         f"slice: {sp * tp} chips vs {n_total // n_slices} per slice)")

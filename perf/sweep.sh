@@ -19,26 +19,46 @@ import json, sys
 print(json.dumps({"section": "cmd", "argv": sys.argv[1]}))
 PY
     local line
-    if line=$("$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
+    if line=$(timeout 900 "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
         echo "$line" | tee -a "$OUT"
     else
         python - "$*" <<'PY' | tee -a "$OUT"
 import json, sys
 print(json.dumps({"section": "error", "argv": sys.argv[1],
-                  "error": "command failed or produced no output"}))
+                  "error": "command failed, hung (900s watchdog), or produced no output"}))
+PY
+    fi
+}
+
+# multi-line sections run under the same watchdog/error-record discipline as run():
+# a wedged tunnel (the documented outage mode) must neither hang the sweep nor
+# vanish silently from the output
+run_all() {
+    python - "$*" <<'PY' | tee -a "$OUT"
+import json, sys
+print(json.dumps({"section": "cmd", "argv": sys.argv[1]}))
+PY
+    local out
+    if out=$(timeout 900 "$@" 2>/dev/null) && [ -n "$out" ]; then
+        echo "$out" | tee -a "$OUT"
+    else
+        python - "$*" <<'PY' | tee -a "$OUT"
+import json, sys
+print(json.dumps({"section": "error", "argv": sys.argv[1],
+                  "error": "command failed, hung (900s watchdog), or produced no output"}))
 PY
     fi
 }
 
 # platform characteristics (dispatch overhead, streaming ceiling, kernel GB/s,
 # windowed-vs-full attention) — includes the i4p vs i4p-inline vs i8 kernel A/B
-python perf/microbench.py | tee -a "$OUT"
+run_all python perf/microbench.py
 
 # quantized_psum numerics + quantize/dequant compute cost on the 8-way virtual CPU
 # mesh (one real chip has no ICI; the record carries mesh=cpu so it cannot be
 # mistaken for an ICI time)
-JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    python perf/microbench.py --section collectives | tee -a "$OUT"
+run_all env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python perf/microbench.py --section collectives
 
 # headline decode: 4-bit kernel, windowed attention, host loop
 run python bench.py --steps 64

@@ -69,11 +69,13 @@ def test_deferred_matches_inscan_per_row_positions():
     params = init_random_params(spec, FloatType.F32, seed=5)
     rope = RopeTables.create(spec)
 
-    # seed both rows' caches at different depths with a shared prefill
+    # seed both rows' caches, then decode with the rows at DIFFERENT depths — the
+    # per-row slot masking and the vmap'd per-row commit must each honor its own
+    # offset (identical offsets would be indistinguishable from the scalar path)
     kc, vc = init_kv_cache(spec, batch=2)
-    seed = jnp.asarray([[1, 2, 3], [4, 5, 6]])
+    seed = jnp.asarray([[1, 2, 3, 11, 12], [4, 5, 6, 13, 14]])
     _, kc, vc = forward(params, spec, rope, seed, kc, vc, jnp.int32(0))
-    pos = jnp.asarray([3, 3], jnp.int32)
+    pos = jnp.asarray([5, 2], jnp.int32)
 
     tok = jnp.asarray([[7], [8]])
     li, kci, vci = _run(spec, params, rope, tok, pos, "inscan", kc, vc)
