@@ -270,6 +270,14 @@ class Engine:
         # store) or dp sharding, tile the row across the batch so token/cache/pos
         # shapes stay congruent (rows 1.. do redundant work; BatchEngine drives the
         # step directly with real per-row data instead)
+        if self.batch > 1 and not getattr(self, "_warned_tiled_batch", False):
+            self._warned_tiled_batch = True
+            import sys
+
+            print(f"⚠️  Engine(batch={self.batch}) host loop tiles one sequence "
+                  f"across all {self.batch} rows — {self.batch}x redundant compute. "
+                  "Use BatchEngine (api_server --batch) to drive real per-row "
+                  "requests.", file=sys.stderr)
         toks = jnp.tile(jnp.asarray(tokens)[None, :], (self.batch, 1))
         logits, self.k_cache, self.v_cache = step(
             self.params, self.rope, toks, self.k_cache,

@@ -34,7 +34,7 @@ run() {
   python -m distributed_llama_tpu.apps.dllama inference \
     --model "$MODEL" --tokenizer "$TOKENIZER" \
     --prompt "$PROMPT" --steps "$STEPS" --temperature 0 --seed 12345 "$@" \
-    | grep -v '^🔶\|^⏩\|^💡\|^🔷\|^Columns\|^S/R\|tokens\|time:' || true
+    | grep -v '^🔶\|^⏩\|^💡\|^🔷\|^Columns\|^S/R\|tokens\|time:\|^Weight stream' || true
 }
 
 OUT1=$(run)
