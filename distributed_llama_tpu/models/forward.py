@@ -130,6 +130,20 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)  # (B, hk, T, hs)
         v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
         win = window or s
+        if use_pallas and t == 1 and b == 1 and start_pos.ndim == 0:
+            # fused decode kernel: the cache window is DMA'd straight out of the
+            # stacked buffers inside the kernel (ops/pallas_attention.py) — no
+            # per-layer dynamic-slice materialization in XLA at all
+            from ..ops.pallas_attention import fused_decode_attention
+
+            g = hq_local // hk
+            out = fused_decode_attention(
+                q.reshape(hk, g, hs).astype(jnp.float32), kc, vc,
+                k_t[0], v_t[0], layer_idx, start_pos, window=win)
+            att = out.reshape(1, 1, hq_local * hs).astype(x.dtype)
+            attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas),
+                                   axis_name, compress)
+            return attn_out, (k_t, v_t)
         kw = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
         vw = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
         # window slot j holds a committed row iff j < start_pos; stale slots get a

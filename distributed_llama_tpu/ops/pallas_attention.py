@@ -1,0 +1,111 @@
+"""Fused decode attention — windowed cache read + GQA scores + softmax + AV in one
+kernel, reading the cache window STRAIGHT out of the stacked (L, B, hk, S, hs)
+buffers.
+
+The XLA path (models/forward.py deferred branch + ops/attention.py) materializes a
+(B, hk, win, hs) dynamic-slice of each cache per layer before attention — at 7B /
+window 256 that is ~134 MB/step of slice traffic plus separate softmax fusions (the
+`dynamic-slice_bitcast_fusion` + `convert_reduce_fusion` lines in the round-4
+profile, ~4-5 ms/step together). This kernel takes the FULL stacked caches as
+operands and lets the Pallas pipeline DMA exactly the (layer_idx, 0, h, 0:win)
+block per kv-head grid step — the layer index rides in as a scalar-prefetch
+argument, so nothing is sliced or copied in XLA.
+
+The reference's counterpart is the per-head attention loop at
+src/llama2-tasks.cpp:54-94 (dot q·k over 0..pos, softmax, weighted v sum); the
+windowed-read semantics match ops/attention.gqa_attention with the deferred-write
+key layout: window slots are valid iff slot < pos, and the current token's k/v
+(not yet committed to the cache) attends from registers.
+
+Decode-only by design: T = 1 query row, scalar pos (the host-loop/device-loop hot
+path). Prefill and batched/per-row paths keep the XLA route, which amortizes fine
+at T > 1.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # f32 mask value; exp(_NEG - max) == 0 exactly in f32
+
+
+def _kernel(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref):
+    """Grid step = one kv head. Blocks:
+    q (1, g, hs) f32 | k_new/v_new (1, 1, hs) | kw/vw (1, 1, win, hs) cache dtype |
+    out (1, g, hs) f32. pos is scalar-prefetched."""
+    pos = pos_ref[0]
+    q = q_ref[0]  # (g, hs) f32
+    kw = kw_ref[0, 0].astype(jnp.float32)  # (win, hs)
+    vw = vw_ref[0, 0].astype(jnp.float32)
+    kn = kn_ref[0].astype(jnp.float32)  # (1, hs) current token
+    vn = vn_ref[0].astype(jnp.float32)
+    win = kw.shape[0]
+    scale = jnp.float32(1.0 / math.sqrt(q.shape[-1]))
+
+    s_old = jax.lax.dot_general(q, kw, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # (g, win)
+    slot = jax.lax.broadcasted_iota(jnp.int32, s_old.shape, 1)
+    s_old = jnp.where(slot < pos, s_old, _NEG)  # committed rows only
+    s_new = jnp.sum(q * kn, axis=-1, keepdims=True) * scale  # (g, 1) current token
+
+    m = jnp.maximum(jnp.max(s_old, axis=1, keepdims=True), s_new)  # (g, 1)
+    p_old = jnp.exp(s_old - m)  # (g, win); masked slots exp(_NEG - m) == 0
+    p_new = jnp.exp(s_new - m)  # (g, 1)
+    denom = jnp.sum(p_old, axis=1, keepdims=True) + p_new
+    out = jax.lax.dot_general(p_old, vw, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)  # (g, hs)
+    out = (out + p_new * vn) / denom
+    o_ref[0] = out
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def fused_decode_attention(q, kc, vc, k_new, v_new, layer_idx, pos, *,
+                           window: int, interpret: bool | None = None):
+    """One decode token's attention for one layer against the stacked caches.
+
+    q: (hk, g, hs) f32/bf16 — query heads grouped per kv head.
+    kc/vc: (L, B=1, hk, S, hs) FULL stacked caches (any dtype); only the
+        (layer_idx, 0, h, 0:window) block is ever moved on-chip.
+    k_new/v_new: (hk, 1, hs) — the current token's uncommitted k/v.
+    layer_idx, pos: i32 scalars. window: static read bound (>= pos+1... the
+        current token comes from k_new, so window >= pos suffices).
+    Returns (hk, g, hs) f32.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    hk, g, hs = q.shape
+    l, b, hk2, s, hs2 = kc.shape
+    assert b == 1 and hk2 == hk and hs2 == hs, (q.shape, kc.shape)
+    assert k_new.shape == (hk, 1, hs), k_new.shape
+    win = min(window, s)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # (layer_idx_arr, pos_arr)
+        grid=(hk,),
+        in_specs=[
+            pl.BlockSpec((1, g, hs), lambda h, li, po: (h, 0, 0)),
+            pl.BlockSpec((1, 1, hs), lambda h, li, po: (h, 0, 0)),
+            pl.BlockSpec((1, 1, hs), lambda h, li, po: (h, 0, 0)),
+            pl.BlockSpec((1, 1, win, hs), lambda h, li, po: (li[0], h, 0, 0)),
+            pl.BlockSpec((1, 1, win, hs), lambda h, li, po: (li[0], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, g, hs), lambda h, li, po: (h, 0, 0)),
+    )
+    kern = functools.partial(_kernel)
+
+    def kernel(li_ref, pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref):
+        kern(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref)
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((hk, g, hs), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray([layer_idx], jnp.int32), jnp.asarray([pos], jnp.int32),
+      q.astype(jnp.float32), k_new, v_new,
+      kc.reshape(l, hk, s, hs), vc.reshape(l, hk, s, hs))

@@ -1,0 +1,66 @@
+"""Fused decode-attention kernel vs the XLA deferred-layout oracle (interpret mode).
+
+The kernel must reproduce ops/attention.gqa_attention over the deferred-write key
+layout ([window slots ++ current token], stale slots masked) for every (pos, window)
+relationship decode meets: empty cache, partially filled window, full window.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llama_tpu.ops.attention import gqa_attention
+from distributed_llama_tpu.ops.pallas_attention import fused_decode_attention
+
+
+def _oracle(q_btgh, kc, vc, k_new, v_new, layer_idx, pos, window):
+    """XLA composition: windowed slice + concat current token + masked attention."""
+    l, b, hk, s, hs = kc.shape
+    win = min(window, s)
+    kw = kc[layer_idx, :, :, :win]  # (B, hk, win, hs)
+    vw = vc[layer_idx, :, :, :win]
+    slot = jnp.arange(win)
+    slot_pos = jnp.where(slot < pos, slot, s + 1)
+    key_pos = jnp.concatenate([slot_pos, jnp.asarray([pos])])
+    kfull = jnp.concatenate([kw, k_new[None]], axis=2)  # (1, hk, win+1, hs)
+    vfull = jnp.concatenate([vw, v_new[None]], axis=2)
+    return gqa_attention(q_btgh, kfull, vfull, jnp.asarray([pos]),
+                         key_positions=key_pos)
+
+
+@pytest.mark.parametrize("pos,window", [(0, 16), (5, 16), (15, 16), (16, 32), (40, 64)])
+@pytest.mark.parametrize("g", [1, 4])
+def test_fused_decode_attention_matches_oracle(pos, window, g):
+    hk, hs, s, l = 4, 32, 64, 3
+    hq = hk * g
+    rng = np.random.RandomState(pos * 7 + g)
+    kc = jnp.asarray(rng.randn(l, 1, hk, s, hs).astype(np.float32))
+    vc = jnp.asarray(rng.randn(l, 1, hk, s, hs).astype(np.float32))
+    k_new = jnp.asarray(rng.randn(hk, 1, hs).astype(np.float32))
+    v_new = jnp.asarray(rng.randn(hk, 1, hs).astype(np.float32))
+    q = jnp.asarray(rng.randn(hk, g, hs).astype(np.float32))
+    layer_idx = 1
+
+    got = fused_decode_attention(q, kc, vc, k_new, v_new, layer_idx, pos,
+                                 window=window, interpret=True)
+    # oracle consumes (B, T, hq, hs) and returns (B, T, hq*hs)
+    q_btgh = q.reshape(1, 1, hq, hs)
+    want = _oracle(q_btgh, kc, vc, k_new, v_new, layer_idx, pos, window)
+    np.testing.assert_allclose(np.asarray(got).reshape(1, 1, hq * hs),
+                               np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_fused_decode_attention_bf16_cache():
+    hk, g, hs, s, l = 2, 2, 32, 32, 2
+    rng = np.random.RandomState(0)
+    kc = jnp.asarray(rng.randn(l, 1, hk, s, hs).astype(np.float32)).astype(jnp.bfloat16)
+    vc = jnp.asarray(rng.randn(l, 1, hk, s, hs).astype(np.float32)).astype(jnp.bfloat16)
+    k_new = jnp.asarray(rng.randn(hk, 1, hs)).astype(jnp.bfloat16)
+    v_new = jnp.asarray(rng.randn(hk, 1, hs)).astype(jnp.bfloat16)
+    q = jnp.asarray(rng.randn(hk, g, hs).astype(np.float32))
+    got = fused_decode_attention(q, kc, vc, k_new, v_new, 0, 7, window=16,
+                                 interpret=True)
+    want = _oracle(q.reshape(1, 1, hk * g, hs), kc, vc, k_new, v_new, 0, 7, 16)
+    np.testing.assert_allclose(np.asarray(got).reshape(1, 1, -1), np.asarray(want),
+                               atol=2e-2, rtol=2e-2)
