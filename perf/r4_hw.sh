@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-4 hardware runbook — the full post-recovery measurement sequence,
+# serialized (concurrent TPU jobs wedge the axon tunnel; see PROFILE.md).
+#   bash perf/r4_hw.sh [outfile]
+cd "$(dirname "$0")/.."
+OUT="${1:-perf/r4_hw_results.jsonl}"
+: > "$OUT"
+
+note() { python -c "import json,sys;print(json.dumps({'section':'cmd','argv':sys.argv[1]}))" "$*" | tee -a "$OUT"; }
+run() {
+    note "$*"
+    local line
+    if line=$(timeout 900 "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
+        echo "$line" | tee -a "$OUT"
+    else
+        python -c "import json,sys;print(json.dumps({'section':'error','argv':sys.argv[1],'error':'failed/hung/empty'}))" "$*" | tee -a "$OUT"
+    fi
+}
+
+# 1. headline with the deferred cache discipline (new default)
+run python bench.py --steps 32
+# 2. cache-write A/B: the carry-copy question
+run python bench.py --steps 32 --cache-write inscan
+# 3. device-loop amortization
+run python bench.py --steps 32 --device-loop 8
+run python bench.py --steps 64 --device-loop 32
+# 4. forced-failure fallback drill (must print an i8 line with fallback_reason)
+note "DLT_FORCE_I4P_FAILURE=1 python bench.py --steps 4"
+line=$(DLT_FORCE_I4P_FAILURE=1 timeout 900 python bench.py --steps 4 2>/dev/null | tail -1)
+echo "${line:-'{"section":"error","argv":"drill","error":"failed/hung/empty"}'}" | tee -a "$OUT"
+# 5. the full sweep (window sweep, prefill, other archs, microbench, collectives)
+bash perf/sweep.sh
+echo "r4 hw runbook complete -> $OUT + perf/sweep_results.jsonl"
