@@ -326,7 +326,7 @@ def main(argv=None) -> None:
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
             slots=args.batch, tp=args.tp, dp=args.dp, pod=args.pod,
-            cache_write=args.cache_write,
+            cache_write=args.cache_write, moe_sharding=args.moe_sharding,
             dtype=(None if args.dtype == "auto"
                    else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
             use_pallas=False if args.no_pallas else None,

@@ -68,6 +68,12 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="auto", choices=["auto", "float32", "bfloat16"],
                    help="auto = bfloat16 on TPU, float32 on CPU")
     p.add_argument("--no-pallas", action="store_true")
+    p.add_argument("--moe-sharding", default="slice", choices=["slice", "expert"],
+                   help="MoE expert placement over the tp axis: 'slice' TP-slices "
+                        "every expert's hidden dim (the reference's scheme); "
+                        "'expert' shards WHOLE experts (each chip owns E/tp experts "
+                        "— the capacity axis for Grok-1-314B-class expert weights; "
+                        "requires n_experts %% tp == 0)")
     p.add_argument("--cache-write", default="deferred",
                    choices=["deferred", "inscan"],
                    help="KV cache discipline (models/forward.py): 'deferred' keeps "
@@ -136,7 +142,7 @@ def make_engine(args) -> Engine:
                else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
         use_pallas=False if args.no_pallas else None,
         compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1,
-        cache_write=args.cache_write,
+        cache_write=args.cache_write, moe_sharding=args.moe_sharding,
     )
     print(f"⏩ Loaded model in {time.perf_counter() - t0:.1f}s "
           f"(tp={engine.tp}, pallas={engine.use_pallas})")

@@ -204,9 +204,15 @@ def _gather_expert(w, idx):
 def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
     """Top-k MoE FFN (grokMoeRouter..grokMoeBlock2, grok1-tasks.cpp:56-228).
 
-    Router runs replicated (the reference runs it root-only and broadcasts indexes);
-    expert weights are TP-sliced on the hidden axis exactly like the dense FFN, so the
-    down-matmul partial sums psum across the tp axis.
+    Router runs replicated (the reference runs it root-only and broadcasts indexes).
+    Two expert shardings (parallel/sharding.py):
+    - slice (default): every expert's hidden axis is TP-sliced like the dense FFN;
+      the down-matmul partial sums psum across tp.
+    - expert: whole experts shard over tp (detected here by the LOCAL stack's
+      expert count being smaller than spec.n_experts under shard_map) — each shard
+      computes only the active experts it owns (lax.cond keeps non-owners from
+      streaming weights) and the same psum merges the contributions. The capacity
+      axis for Grok-1-314B-class expert weights; no reference counterpart.
     """
     b, t, d = xb.shape
     k = spec.n_active_experts
@@ -216,6 +222,11 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
     probs = jax.nn.softmax(router_logits, axis=-1)  # softmax over ALL experts
     top_p, top_i = jax.lax.top_k(probs, k)  # (B, T, K)
     weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)  # renormalize (grokMoeNormWeights)
+
+    el = bp["moe_up"].shape[0]  # shard-local expert count
+    if axis_name is not None and el != spec.n_experts:
+        return _moe_ffn_expert_sharded(xb, bp, spec, axis_name, use_pallas, compress,
+                                       top_i, weights, el)
 
     if use_pallas and b * t == 1 and bp["moe_up"].layout in ("i4p", "i8"):
         # Decode through the fused matvec kernels: dynamic_slice each active expert's
@@ -261,6 +272,59 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
         out, _ = jax.lax.scan(
             expert_step, jnp.zeros_like(xb),
             (bp["moe_up"], bp["moe_gate"], bp["moe_down"], combine))
+    return _maybe_psum(out, axis_name, compress)
+
+
+def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress,
+                            top_i, weights, el):
+    """Expert-parallel MoE FFN body: this shard owns experts
+    [shard*el, (shard+1)*el). Decode runs one lax.cond per active expert (owners
+    stream and compute, everyone else contributes zeros for free); prefill scans
+    the local expert stack with the global routing weights sliced to the local
+    window. The trailing psum is the merge point either way."""
+    b, t, _ = xb.shape
+    k = spec.n_active_experts
+    act = _act(spec)
+    shard = jax.lax.axis_index(axis_name)
+    offset = shard * el
+
+    def expert_q(wstack, e):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_slice_in_dim(a, e, 1, 0)[0], wstack)
+
+    if b * t == 1:
+        out = jnp.zeros_like(xb)
+        for j in range(k):
+            e_rel = top_i.reshape(k)[j] - offset
+            in_range = (e_rel >= 0) & (e_rel < el)
+            e_loc = jnp.clip(e_rel, 0, el - 1)
+            w_j = weights.reshape(k)[j].astype(xb.dtype)
+
+            def compute(e_loc=e_loc):
+                hb = qmatmul(xb, expert_q(bp["moe_up"], e_loc),
+                             use_pallas=use_pallas) * act(
+                    qmatmul(xb, expert_q(bp["moe_gate"], e_loc),
+                            use_pallas=use_pallas))
+                return qmatmul(hb, expert_q(bp["moe_down"], e_loc),
+                               use_pallas=use_pallas)
+
+            out_e = jax.lax.cond(in_range, compute, lambda: jnp.zeros_like(xb))
+            out = out + out_e * w_j
+    else:
+        one_hot = jax.nn.one_hot(top_i, spec.n_experts, dtype=xb.dtype)  # (B,T,K,E)
+        combine = jnp.einsum("btke,btk->ebt", one_hot, weights.astype(xb.dtype))
+        combine_local = jax.lax.dynamic_slice_in_dim(combine, offset, el, 0)
+
+        def expert_step(acc, ew):
+            up_e, gate_e, down_e, comb = ew
+            hb = qmatmul(xb, up_e, use_pallas=use_pallas) * act(
+                qmatmul(xb, gate_e, use_pallas=use_pallas))
+            out_e = qmatmul(hb, down_e, use_pallas=use_pallas)
+            return acc + out_e * comb[..., None], None
+
+        out, _ = jax.lax.scan(
+            expert_step, jnp.zeros_like(xb),
+            (bp["moe_up"], bp["moe_gate"], bp["moe_down"], combine_local))
     return _maybe_psum(out, axis_name, compress)
 
 

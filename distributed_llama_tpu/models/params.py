@@ -119,16 +119,20 @@ def _decode_layout(t: QTensor, tp: int, col_sharded: bool) -> QTensor:
     return t.to_i8_layout()
 
 
-def prepare_for_pallas(params: Params, tp: int = 1) -> Params:
+def prepare_for_pallas(params: Params, tp: int = 1,
+                       moe_sharding: str = "slice") -> Params:
     """Repack the dense matmul weights into the Pallas decode-kernel layouts
     (i4p packed nibbles for Q40, int8 planes for Q80). Row/col TP slices stay
     32-block-aligned; col-sharded tensors are packed per TP column group so each
-    shard's slice is self-contained."""
+    shard's slice is self-contained. Under expert sharding the MoE stacks shard by
+    whole experts, so their in-axes are NOT column-sliced and pack with groups=1."""
     out: Params = {"embedding": params["embedding"], "blocks": {},
                    "rms_final": params["rms_final"]}
     for name, t in params["blocks"].items():
         if name in _DENSE_MATMULS and _kernel_convertible(t, stacked=True):
-            out["blocks"][name] = _decode_layout(t, tp, name in _COL_SHARDED)
+            col = name in _COL_SHARDED and not (
+                moe_sharding == "expert" and name.startswith("moe_"))
+            out["blocks"][name] = _decode_layout(t, tp, col)
         else:
             out["blocks"][name] = t
     wcls = params["wcls"]

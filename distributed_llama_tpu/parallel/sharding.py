@@ -47,9 +47,29 @@ _BLOCK_SPECS = {
 }
 
 
-def param_pspecs(params: dict[str, Any]) -> dict[str, Any]:
-    """PartitionSpec pytree (prefix) matching a params dict."""
+# expert parallelism: the MoE stacks shard by WHOLE experts over the tp axis
+# instead of slicing every expert's hidden dim. Each shard owns E/tp complete
+# experts; a decode step streams only the active experts' weights on their owner
+# shards, and the existing FFN-output psum merges contributions. This is the
+# capacity axis for MoE models whose expert weights dwarf one chip's HBM
+# (Grok-1-314B class) — the reference has no counterpart (it always slices).
+_EP_SPECS = {
+    "moe_up": P(None, AXIS_TP),    # (L, E->tp, hidden, dim), experts whole
+    "moe_gate": P(None, AXIS_TP),
+    "moe_down": P(None, AXIS_TP),  # (L, E->tp, dim, hidden)
+}
+
+
+def param_pspecs(params: dict[str, Any],
+                 moe_sharding: str = "slice") -> dict[str, Any]:
+    """PartitionSpec pytree (prefix) matching a params dict.
+
+    moe_sharding: "slice" (hidden-dim TP inside every expert, the default) or
+    "expert" (whole experts over tp — see _EP_SPECS)."""
+    assert moe_sharding in ("slice", "expert"), moe_sharding
     blocks = {k: _BLOCK_SPECS[k] for k in params["blocks"]}
+    if moe_sharding == "expert":
+        blocks.update({k: v for k, v in _EP_SPECS.items() if k in blocks})
     return {
         "embedding": P(),  # replicated, root-only-F32 in reference (transformer.cpp:496)
         "blocks": blocks,
@@ -91,7 +111,8 @@ def effective_kv_heads(spec: ModelSpec, tp: int) -> int:
     return tp
 
 
-def check_divisibility(spec: ModelSpec, tp: int, sp: int = 1) -> None:
+def check_divisibility(spec: ModelSpec, tp: int, sp: int = 1,
+                       moe_sharding: str = "slice") -> None:
     """Even-division checks that replace the reference's 2^n assumption and its
     nSlices <= nKvHeads limit (transformer.cpp:108-111; lifted via KV-head
     replication, see effective_kv_heads)."""
@@ -101,9 +122,17 @@ def check_divisibility(spec: ModelSpec, tp: int, sp: int = 1) -> None:
         "for KV-head replication)")
     assert spec.n_heads % tp == 0, (
         f"tp={tp} must divide n_heads={spec.n_heads}")
-    assert spec.dim % tp == 0 and spec.hidden_dim % tp == 0
+    assert spec.dim % tp == 0
     assert spec.vocab_size % tp == 0
-    if (spec.dim // tp) % 32 or (spec.hidden_dim // tp) % 32:
+    if (spec.dim // tp) % 32:
         raise AssertionError("tp slice must keep 32-wide quant blocks intact")
+    if moe_sharding == "expert" and spec.is_moe:
+        assert spec.n_experts % tp == 0, (
+            f"expert sharding: tp={tp} must divide n_experts={spec.n_experts}")
+    else:
+        # hidden dim is TP-sliced (dense FFN always; MoE experts in slice mode)
+        assert spec.hidden_dim % tp == 0
+        if (spec.hidden_dim // tp) % 32:
+            raise AssertionError("tp slice must keep 32-wide quant blocks intact")
     assert spec.seq_len % sp == 0, (
         f"sp={sp} must divide seq_len={spec.seq_len} (sequence-sharded KV cache)")

@@ -68,7 +68,8 @@ def _repeat_kv_rows(t: QTensor | Any, hk: int, rep: int) -> Any:
 
 
 def shard_params(params: dict[str, Any], mesh: Mesh,
-                 spec: ModelSpec | None = None) -> dict[str, Any]:
+                 spec: ModelSpec | None = None,
+                 moe_sharding: str = "slice") -> dict[str, Any]:
     """Place params on the mesh per param_pspecs — the TPU-native 'loadRoot' weight
     distribution (transformer.cpp:480-539) with device_put instead of socket writes.
 
@@ -76,7 +77,7 @@ def shard_params(params: dict[str, Any], mesh: Mesh,
     before placement, lifting the reference's nSlices <= nKvHeads limit."""
     tp = mesh.shape[AXIS_TP]
     if spec is not None:
-        check_divisibility(spec, tp)
+        check_divisibility(spec, tp, moe_sharding=moe_sharding)
         hk_eff = effective_kv_heads(spec, tp)
         if hk_eff != spec.n_kv_heads:
             rep = hk_eff // spec.n_kv_heads
@@ -84,7 +85,7 @@ def shard_params(params: dict[str, Any], mesh: Mesh,
             for name in ("wk", "wv"):
                 params["blocks"][name] = _repeat_kv_rows(
                     params["blocks"][name], spec.n_kv_heads, rep)
-    pspec_tree = _expand_pspec_tree(params, param_pspecs(params))
+    pspec_tree = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
 
     def put(leaf, spec):
         return jax.device_put(leaf, NamedSharding(mesh, spec))
@@ -112,7 +113,8 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
                          dtype=None, use_pallas: bool = False,
                          compress_collectives: bool = False, donate_cache: bool = True,
                          attn_window: int | None = None,
-                         cache_write: str = "inscan"):
+                         cache_write: str = "inscan",
+                         moe_sharding: str = "slice"):
     """Build the jitted SPMD forward step over the mesh's tp axis.
 
     Returns fn(params, rope, tokens, k_cache, v_cache, start_pos) ->
@@ -127,12 +129,12 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
     tp = mesh.shape[AXIS_TP]
     sp = mesh.shape.get(AXIS_SP, 1)
     dp = mesh.shape.get(AXIS_DP, 1)
-    check_divisibility(spec, tp, sp)
+    check_divisibility(spec, tp, sp, moe_sharding=moe_sharding)
     dtype = dtype or jnp.float32
     if sp > 1:
         attn_window = None  # ring attention always walks the full sharded cache
 
-    param_specs = _expand_pspec_tree(params, param_pspecs(params))
+    param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
     kv_spec = kv_cache_pspec_for_mesh(mesh)
     # data parallelism: batch rows shard over dp (cache rows already carry AXIS_DP on
     # their batch axis); each dp group runs an independent replica of the tp/sp

@@ -84,7 +84,8 @@ def device_sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
 def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str = "greedy",
                      dtype=None, use_pallas: bool = False,
                      compress_collectives: bool = False, donate_cache: bool = True,
-                     attn_window: int | None = None, cache_write: str = "inscan"):
+                     attn_window: int | None = None, cache_write: str = "inscan",
+                     moe_sharding: str = "slice"):
     """Build fn(params, rope, token, kc, vc, start_pos, key, temperature, topp) ->
     (tokens (n_steps,), last_logits (vocab,), kc, vc).
 
@@ -100,7 +101,7 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     sp = mesh.shape.get(AXIS_SP, 1)
     if sp > 1:
         attn_window = None  # ring attention always walks the full sharded cache
-    param_specs = _expand_pspec_tree(params, param_pspecs(params))
+    param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
     kv_spec = kv_cache_pspec_for_mesh(mesh)
     rope_type = spec.rope_type
 

@@ -84,7 +84,8 @@ class Engine:
                  *, tp: int | None = None, sp: int = 1, dp: int = 1, dtype=None,
                  use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1,
-                 pod: bool = False, cache_write: str = "deferred"):
+                 pod: bool = False, cache_write: str = "deferred",
+                 moe_sharding: str = "slice"):
         self.spec = spec
         self.tokenizer = tokenizer
         on_tpu = jax.default_backend() == "tpu"
@@ -121,13 +122,19 @@ class Engine:
         # ~11.6 ms/token at 7B). "inscan" is the per-layer in-place form (required
         # with sp: ring attention owns its cache update).
         self.cache_write = "inscan" if sp > 1 else cache_write
+        # MoE expert placement: "slice" TP-slices every expert's hidden axis (the
+        # reference's scheme); "expert" shards WHOLE experts over tp — the capacity
+        # axis for Grok-1-314B-class expert weights (parallel/sharding.py)
+        self.moe_sharding = moe_sharding if spec.is_moe else "slice" 
         has_quant = any(
             getattr(t, "ftype", None) in (FloatType.Q40, FloatType.Q80)
             for t in params["blocks"].values())
         self.use_pallas = use_pallas and has_quant
         if self.use_pallas:
-            params = prepare_for_pallas(params, self.tp)
-        self.params = shard_params(params, self.mesh, spec)
+            params = prepare_for_pallas(params, self.tp,
+                                        moe_sharding=self.moe_sharding)
+        self.params = shard_params(params, self.mesh, spec,
+                                   moe_sharding=self.moe_sharding)
         # global (all-shard) weight bytes one decode step streams — per-chip traffic
         # divides by tp; used for the achieved-GB/s printout (perf/PROFILE.md)
         self.decode_weight_bytes = decode_stream_bytes(self.params, spec)
@@ -162,7 +169,7 @@ class Engine:
                 self.spec, self.mesh, self.params, dtype=self.dtype,
                 use_pallas=self.use_pallas, compress_collectives=self.compress,
                 donate_cache=True, attn_window=window,
-                cache_write=self.cache_write)
+                cache_write=self.cache_write, moe_sharding=self.moe_sharding)
         return self._steps[window]
 
     @property
@@ -352,7 +359,8 @@ class Engine:
                 self.spec, self.mesh, self.params, chunk, mode=mode, dtype=self.dtype,
                 use_pallas=self.use_pallas,
                 compress_collectives=self.compress, donate_cache=True,
-                attn_window=window, cache_write=self.cache_write)
+                attn_window=window, cache_write=self.cache_write,
+                moe_sharding=self.moe_sharding)
         return self._decode_loops[chunk, mode, window]
 
     def _loop_traffic(self, chunk: int, mode: str, loop):
