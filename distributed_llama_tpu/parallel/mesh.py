@@ -11,6 +11,18 @@ axes:
 Collectives ride ICI when the mesh axes are laid out within a slice, DCN across slices —
 XLA handles placement; we only pick axis sizes. The reference's 2^n-nodes restriction
 (README.md:33-34) disappears: any divisor layout works.
+
+A fourth capacity strategy, expert parallelism, rides the tp axis rather than adding a
+mesh axis: moe_sharding="expert" (parallel/sharding.py) shards WHOLE experts over tp
+while attention stays head-sharded — same mesh, different PartitionSpecs.
+
+Pipeline parallelism is deliberately absent: for autoregressive DECODE a layer
+pipeline serializes on the single in-flight token (the bubble is the whole pipeline),
+and on TPU the per-layer all-reduce that tp costs rides ICI at full bandwidth, so tp
+(+ ep for MoE capacity, + sp for context capacity) dominates pp at every scale the
+BASELINE targets — including 405B on a v5p-16, which fits tp=16 across the slice.
+pp earns its bubbles only in throughput-batch prefill/training regimes the reference
+(and this framework's serving focus) does not target.
 """
 
 from __future__ import annotations
