@@ -292,24 +292,34 @@ def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, comp
         return jax.tree_util.tree_map(
             lambda a: jax.lax.dynamic_slice_in_dim(a, e, 1, 0)[0], wstack)
 
-    if b * t == 1:
-        out = jnp.zeros_like(xb)
-        for j in range(k):
-            e_rel = top_i.reshape(k)[j] - offset
-            in_range = (e_rel >= 0) & (e_rel < el)
-            e_loc = jnp.clip(e_rel, 0, el - 1)
-            w_j = weights.reshape(k)[j].astype(xb.dtype)
+    if t == 1 and b * k <= 2 * spec.n_experts:
+        # decode (incl. batched slots): one cond per (row, active expert) — owner
+        # shards stream and compute exactly the routed experts, everyone else's
+        # branch is a free zero. Unrolls b*k conds, so bounded to small batches;
+        # bigger batches amortize fine through the local-stack scan below.
+        rows = []
+        for r in range(b):
+            row_x = xb[r:r + 1]
+            row_out = jnp.zeros_like(row_x)
+            for j in range(k):
+                e_rel = top_i[r, 0, j] - offset
+                in_range = (e_rel >= 0) & (e_rel < el)
+                e_loc = jnp.clip(e_rel, 0, el - 1)
+                w_j = weights[r, 0, j].astype(xb.dtype)
 
-            def compute(e_loc=e_loc):
-                hb = qmatmul(xb, expert_q(bp["moe_up"], e_loc),
-                             use_pallas=use_pallas) * act(
-                    qmatmul(xb, expert_q(bp["moe_gate"], e_loc),
-                            use_pallas=use_pallas))
-                return qmatmul(hb, expert_q(bp["moe_down"], e_loc),
-                               use_pallas=use_pallas)
+                def compute(row_x=row_x, e_loc=e_loc):
+                    hb = qmatmul(row_x, expert_q(bp["moe_up"], e_loc),
+                                 use_pallas=use_pallas) * act(
+                        qmatmul(row_x, expert_q(bp["moe_gate"], e_loc),
+                                use_pallas=use_pallas))
+                    return qmatmul(hb, expert_q(bp["moe_down"], e_loc),
+                                   use_pallas=use_pallas)
 
-            out_e = jax.lax.cond(in_range, compute, lambda: jnp.zeros_like(xb))
-            out = out + out_e * w_j
+                out_e = jax.lax.cond(in_range, compute,
+                                     lambda row_x=row_x: jnp.zeros_like(row_x))
+                row_out = row_out + out_e * w_j
+            rows.append(row_out)
+        out = jnp.concatenate(rows, axis=0) if b > 1 else rows[0]
     else:
         one_hot = jax.nn.one_hot(top_i, spec.n_experts, dtype=xb.dtype)  # (B,T,K,E)
         combine = jnp.einsum("btke,btk->ebt", one_hot, weights.astype(xb.dtype))

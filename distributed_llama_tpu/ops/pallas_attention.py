@@ -96,10 +96,9 @@ def fused_decode_attention(q, kc, vc, k_new, v_new, layer_idx, pos, *,
         ],
         out_specs=pl.BlockSpec((1, g, hs), lambda h, li, po: (h, 0, 0)),
     )
-    kern = functools.partial(_kernel)
-
     def kernel(li_ref, pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref):
-        kern(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref)
+        # li_ref is consumed by the BlockSpec index_maps only
+        _kernel(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref)
 
     return pl.pallas_call(
         kernel,

@@ -27,7 +27,10 @@ run python bench.py --steps 64 --device-loop 32
 # 4. forced-failure fallback drill (must print an i8 line with fallback_reason)
 note "DLT_FORCE_I4P_FAILURE=1 python bench.py --steps 4"
 line=$(DLT_FORCE_I4P_FAILURE=1 timeout 900 python bench.py --steps 4 2>/dev/null | tail -1)
-echo "${line:-'{"section":"error","argv":"drill","error":"failed/hung/empty"}'}" | tee -a "$OUT"
+if [ -z "$line" ]; then
+    line='{"section":"error","argv":"drill","error":"failed/hung/empty"}'
+fi
+echo "$line" | tee -a "$OUT"
 # 5. the full sweep (window sweep, prefill, other archs, microbench, collectives)
 bash perf/sweep.sh
 echo "r4 hw runbook complete -> $OUT + perf/sweep_results.jsonl"

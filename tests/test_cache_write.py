@@ -62,6 +62,32 @@ def test_deferred_matches_inscan_prefill_and_decode(window):
     assert np.argmax(np.asarray(ld2)) == np.argmax(np.asarray(li2))
 
 
+def test_deferred_pallas_decode_matches_inscan():
+    """The PRODUCTION TPU decode glue — deferred + use_pallas + t=1 routes through
+    the fused decode-attention kernel (interpret off-TPU) — must match the inscan
+    XLA path at reassociation tolerance. Pins the q.reshape head grouping, k_t[0]
+    shapes, window wiring, and dtype casts of the integrated branch."""
+    spec = _spec(dim=64, hidden_dim=96)
+    params = init_random_params(spec, FloatType.Q40, seed=9)
+    rope = RopeTables.create(spec)
+    from distributed_llama_tpu.models.params import prepare_for_pallas
+
+    pp = prepare_for_pallas(params)
+
+    kc, vc = init_kv_cache(spec)
+    _, kc, vc = forward(params, spec, rope, jnp.asarray([[1, 2, 3]]), kc, vc,
+                        jnp.int32(0))
+    tok = jnp.asarray([[7]])
+    want, _, _ = forward(pp, spec, rope, tok, kc, vc, jnp.int32(3),
+                         use_pallas=True, cache_write="inscan", attn_window=16)
+    got, _, _ = forward(pp, spec, rope, tok, kc, vc, jnp.int32(3),
+                        use_pallas=True, cache_write="deferred", attn_window=16)
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 1e-4, rel
+    assert np.argmax(got, -1).tolist() == np.argmax(want, -1).tolist()
+
+
 def test_deferred_matches_inscan_per_row_positions():
     """Continuous-batching shape: per-row start_pos, batch 2, rows at different
     offsets."""

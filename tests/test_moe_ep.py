@@ -83,6 +83,32 @@ def test_expert_sharded_quantized_kernel_path():
     assert np.argmax(got, -1).tolist() == np.argmax(want, -1).tolist()
 
 
+def test_expert_sharded_batched_decode_matches_replicated():
+    """Batched decode (BatchEngine shape: b=2, t=1, per-row positions) through the
+    per-(row, expert) cond path must match the replicated model per row."""
+    spec = _moe_spec(ArchType.MIXTRAL)
+    params = init_random_params(spec, FloatType.F32, seed=12)
+    rope = RopeTables.create(spec)
+
+    # seed two rows to different depths, replicated oracle
+    kc, vc = init_kv_cache(spec, batch=2)
+    seed = jnp.asarray([[1, 2, 3], [4, 5, 6]])
+    _, kc0, vc0 = forward(params, spec, rope, seed, kc, vc, jnp.int32(0))
+    pos = jnp.asarray([3, 2], jnp.int32)
+    tok = jnp.asarray([[7], [9]])
+    want, _, _ = forward(params, spec, rope, tok, kc0, vc0, pos)
+
+    mesh = make_mesh(tp=4)
+    sharded = shard_params(params, mesh, spec, moe_sharding="expert")
+    step = make_sharded_forward(spec, mesh, sharded, donate_cache=False,
+                                moe_sharding="expert")
+    kc, vc = init_sharded_kv_cache(spec, mesh, batch=2)
+    _, kc1, vc1 = step(sharded, rope, seed, kc, vc, jnp.int32(0))
+    got, _, _ = step(sharded, rope, tok, kc1, vc1, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+
+
 def test_expert_sharding_requires_divisibility():
     from distributed_llama_tpu.parallel.sharding import check_divisibility
 
