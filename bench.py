@@ -232,34 +232,44 @@ def main():
         return params, kc, vc
 
     def compile_with_fallback(make_and_warm):
-        """Build + compile with the preferred layout; on failure retry once with the
-        int8-plane layout so unattended driver runs record a downgraded number (with
-        fallback_reason) instead of crashing.
+        """Build + compile down a degradation ladder so an unattended driver run
+        records a downgraded number (with fallback_reason) instead of crashing:
 
-        The failed parameter set must be FULLY dropped before the retry so peak HBM
-        holds one set. `state.pop("params")` alone is not enough: the caught
+            (requested layout, requested cache_write)
+            -> (i8, requested cache_write)      # 4-bit kernel failed to lower
+            -> (i8, inscan)                     # deferred path / fused attention failed
+
+        Each failed attempt's parameter set must be FULLY dropped before the next so
+        peak HBM holds one set. `state.pop("params")` alone is not enough: the caught
         exception's __traceback__ frames pin `params`/`kc`/`vc` locals of build() and
         make_and_warm(), which kept ~4 GB of i4p arrays alive through the i8 rebuild
         and turned round 3's lowering failure into RESOURCE_EXHAUSTED
         (BENCH_r03.json). Capture the message only, clear the traceback, and
         gc.collect() before re-synthesizing."""
-        nonlocal_layout = state.get("layout") or layout
-        try:
-            return make_and_warm(*build(nonlocal_layout))
-        except Exception as e:
-            if nonlocal_layout != "i4p":
-                raise
-            reason = f"{type(e).__name__}: {e}"[:200]
-            e.__traceback__ = None
-            del e  # drop the exception (and its frame refs) entirely
-            import gc
+        ladder = [(layout, args.cache_write)]
+        if layout == "i4p":
+            ladder.append(("i8", args.cache_write))
+        if args.cache_write != "inscan":
+            ladder.append(("i8" if layout == "i4p" else layout, "inscan"))
+        reasons = []
+        for attempt, (lay, cw) in enumerate(ladder):
+            state["cache_write"] = cw
+            try:
+                return make_and_warm(*build(lay))
+            except Exception as e:
+                reasons.append(f"{lay}/{cw}: {type(e).__name__}: {e}"[:200])
+                e.__traceback__ = None
+                del e  # drop the exception (and its frame refs) entirely
+                import gc
 
-            sys.last_value = sys.last_traceback = None  # in case a REPL hook stashed it
-            print(f"# i4p layout failed ({reason}); retrying with i8", file=sys.stderr)
-            state.update(fallback_reason=reason)
-            state.pop("params", None)
-            gc.collect()
-            return make_and_warm(*build("i8"))
+                sys.last_value = sys.last_traceback = None  # REPL hooks stash these
+                if attempt == len(ladder) - 1:
+                    raise RuntimeError(" | ".join(reasons)) from None
+                print(f"# {reasons[-1]}; retrying with {ladder[attempt + 1]}",
+                      file=sys.stderr)
+                state["fallback_reason"] = " | ".join(reasons)[:400]
+                state.pop("params", None)
+                gc.collect()
 
     # NOTE: on the axon TPU tunnel, block_until_ready() returns before the device is
     # actually done; only a device->host transfer is an honest fence. Materialize a
@@ -288,7 +298,7 @@ def main():
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
                                         use_pallas=on_tpu, donate_cache=True,
                                         attn_window=pwindow,
-                                        cache_write=args.cache_write)
+                                        cache_write=state["cache_write"])
             logits, kc, vc = step(params, rope, toks, kc, vc, jnp.int32(0))  # compile
             np.asarray(logits[0, 0, 0])
             return step, params, kc, vc
@@ -324,7 +334,7 @@ def main():
             loop = make_decode_loop(spec, mesh, params, chunk, mode="greedy",
                                     dtype=dtype, use_pallas=on_tpu,
                                     attn_window=window,
-                                    cache_write=args.cache_write)
+                                    cache_write=state["cache_write"])
             toks, _, kc, vc = loop(params, rope, 1, kc, vc, 0, key)  # compile + warm
             np.asarray(toks)
             return loop, params, kc, vc
@@ -344,7 +354,7 @@ def main():
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
                                         use_pallas=on_tpu, donate_cache=True,
                                         attn_window=window,
-                                        cache_write=args.cache_write)
+                                        cache_write=state["cache_write"])
             logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile
             np.asarray(logits[0, 0, 0])
             return step, params, kc, vc
@@ -373,6 +383,7 @@ def main():
         "weight_gb": round(state["wbytes"] / 1e9, 3),
         "achieved_gbps": round(state["wbytes"] / 1e9 / dt, 1),
         "layout": state["layout"],
+        "cache_write": state["cache_write"],
         "attn_window": window or spec.seq_len,
         "device_loop": args.device_loop,
     }
