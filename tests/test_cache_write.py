@@ -130,6 +130,37 @@ def test_deferred_matches_inscan_moe(arch, kw):
     np.testing.assert_allclose(np.asarray(kcd), np.asarray(kci), atol=1e-6, rtol=1e-4)
 
 
+def test_deferred_pallas_kv_replicated_mesh():
+    """tp=8 > n_kv_heads=2 (the 405B-class GQA shape): deferred + use_pallas decode
+    over the KV-replicated mesh must match the replicated single-device model."""
+    from distributed_llama_tpu.models.params import prepare_for_pallas
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
+                                                   make_sharded_forward, shard_params)
+
+    spec = _spec(dim=256, hidden_dim=256, n_layers=2, n_heads=8, n_kv_heads=2,
+                 vocab_size=128, seq_len=32)
+    params = init_random_params(spec, FloatType.Q40, seed=8)
+    rope = RopeTables.create(spec)
+    kc, vc = init_kv_cache(spec)
+    _, kc, vc = forward(params, spec, rope, jnp.asarray([[1, 2]]), kc, vc,
+                        jnp.int32(0))
+    tok = jnp.asarray([[5]])
+    want, _, _ = forward(params, spec, rope, tok, kc, vc, jnp.int32(2))
+
+    mesh = make_mesh(tp=8)
+    pp = shard_params(prepare_for_pallas(params, tp=8), mesh, spec)
+    step = make_sharded_forward(spec, mesh, pp, donate_cache=False,
+                                use_pallas=True, cache_write="deferred")
+    kc8, vc8 = init_sharded_kv_cache(spec, mesh)
+    _, kc8, vc8 = step(pp, rope, jnp.asarray([[1, 2]]), kc8, vc8, jnp.int32(0))
+    got, _, _ = step(pp, rope, tok, kc8, vc8, jnp.int32(2))
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 0.03, rel  # Q80 activation-quantization error scale
+    assert np.argmax(got, -1).tolist() == np.argmax(want, -1).tolist()
+
+
 def test_deferred_sharded_step_matches_inscan():
     """tp=2 shard_map: the deferred step over the mesh must match the in-scan step."""
     from distributed_llama_tpu.parallel.mesh import make_mesh
