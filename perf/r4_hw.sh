@@ -10,7 +10,7 @@ note() { python -c "import json,sys;print(json.dumps({'section':'cmd','argv':sys
 run() {
     note "$*"
     local line
-    if line=$(timeout 900 "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
+    if line=$(timeout 1500 "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
         echo "$line" | tee -a "$OUT"
     else
         python -c "import json,sys;print(json.dumps({'section':'error','argv':sys.argv[1],'error':'failed/hung/empty'}))" "$*" | tee -a "$OUT"
@@ -26,7 +26,7 @@ run python bench.py --steps 32 --device-loop 8
 run python bench.py --steps 64 --device-loop 32
 # 4. forced-failure fallback drill (must print an i8 line with fallback_reason)
 note "DLT_FORCE_I4P_FAILURE=1 python bench.py --steps 4"
-line=$(DLT_FORCE_I4P_FAILURE=1 timeout 900 python bench.py --steps 4 2>/dev/null | tail -1)
+line=$(DLT_FORCE_I4P_FAILURE=1 timeout 1500 python bench.py --steps 4 2>/dev/null | tail -1)
 if [ -z "$line" ]; then
     line='{"section":"error","argv":"drill","error":"failed/hung/empty"}'
 fi

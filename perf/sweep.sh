@@ -19,13 +19,13 @@ import json, sys
 print(json.dumps({"section": "cmd", "argv": sys.argv[1]}))
 PY
     local line
-    if line=$(timeout 900 "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
+    if line=$(timeout 1500 "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
         echo "$line" | tee -a "$OUT"
     else
         python - "$*" <<'PY' | tee -a "$OUT"
 import json, sys
 print(json.dumps({"section": "error", "argv": sys.argv[1],
-                  "error": "command failed, hung (900s watchdog), or produced no output"}))
+                  "error": "command failed, hung (1500s watchdog), or produced no output"}))
 PY
     fi
 }
@@ -39,13 +39,13 @@ import json, sys
 print(json.dumps({"section": "cmd", "argv": sys.argv[1]}))
 PY
     local out
-    if out=$(timeout 900 "$@" 2>/dev/null) && [ -n "$out" ]; then
+    if out=$(timeout 1500 "$@" 2>/dev/null) && [ -n "$out" ]; then
         echo "$out" | tee -a "$OUT"
     else
         python - "$*" <<'PY' | tee -a "$OUT"
 import json, sys
 print(json.dumps({"section": "error", "argv": sys.argv[1],
-                  "error": "command failed, hung (900s watchdog), or produced no output"}))
+                  "error": "command failed, hung (1500s watchdog), or produced no output"}))
 PY
     fi
 }
