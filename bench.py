@@ -138,12 +138,16 @@ def metric_name(args) -> str:
     return f"{args.arch}_q40_{kind}_tok_s"
 
 
-def probe_backend(timeout_s: float = 180.0) -> tuple[str | None, str]:
+def probe_backend(timeout_s: float | None = None) -> tuple[str | None, str]:
     """Resolve the backend AND fence a tiny op under a watchdog. The axon tunnel can
     wedge such that even backend initialization hangs forever (observed 2026-07-29:
-    >4 h outage); without this, a bench run would hang instead of reporting. Returns
-    (backend name or None, failure description)."""
+    >4 h outage) or crawl so init takes minutes (2026-07-30 half-alive mode);
+    without this, a bench run would hang instead of reporting. Returns
+    (backend name or None, failure description). DLT_PROBE_TIMEOUT overrides."""
     import threading
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("DLT_PROBE_TIMEOUT", 300))
 
     got: list[str] = []
     err: list[str] = []
