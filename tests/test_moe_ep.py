@@ -109,6 +109,24 @@ def test_expert_sharded_batched_decode_matches_replicated():
                                atol=2e-4, rtol=2e-4)
 
 
+def test_engine_expert_sharded_generation():
+    """Engine(moe_sharding='expert') greedy generation over a tp=4 mesh matches the
+    replicated engine token-for-token."""
+    from distributed_llama_tpu.runtime.engine import Engine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    spec = _moe_spec(ArchType.MIXTRAL)
+    params = init_random_params(spec, FloatType.F32, seed=21)
+
+    ref = Engine(spec, params, tp=1)
+    want, _ = ref.generate([1, 5, 9], 6, Sampler(spec.vocab_size, temperature=0.0))
+
+    eng = Engine(spec, params, tp=4, moe_sharding="expert")
+    assert eng.moe_sharding == "expert"
+    got, _ = eng.generate([1, 5, 9], 6, Sampler(spec.vocab_size, temperature=0.0))
+    assert got == want, (got, want)
+
+
 def test_expert_sharding_requires_divisibility():
     from distributed_llama_tpu.parallel.sharding import check_divisibility
 
