@@ -83,13 +83,14 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
     """Sharded attention sub-block against the FULL stacked caches (L, B, hk, S, hs).
 
     Head counts in bp may be TP-local slices; the cache sequence axis may be sp-sharded
-    (ring attention). The layer's new k/v rows are written in place at
-    (layer_idx, :, :, pos) — decode's cache WRITE is T rows, and its READ is only the
-    first `window` positions (a static bucket >= pos+T chosen by the caller), so cache
-    HBM traffic scales with the live context, not the allocated seq_len. The reference
-    gets the same effect for free because its attention loop runs 0..pos
-    (llama2-tasks.cpp:62-93); with XLA's static shapes the window bucket is the
-    equivalent lever.
+    (ring attention). The cache WRITE discipline depends on the caller: in-scan mode
+    updates (layer_idx, :, :, pos) in place and returns the caches; deferred mode
+    returns only the new (k_t, v_t) rows for forward() to commit after the scan.
+    Either way decode's READ is only the first `window` positions (a static bucket
+    >= pos+T chosen by the caller), so cache HBM traffic scales with the live
+    context, not the allocated seq_len. The reference gets the same effect for free
+    because its attention loop runs 0..pos (llama2-tasks.cpp:62-93); with XLA's
+    static shapes the window bucket is the equivalent lever.
     """
     b, t, _ = x.shape
     hs = spec.head_size
