@@ -50,21 +50,45 @@ def emit(path, obj_or_line):
 
 
 def wait_for_backend() -> bool:
+    """In the dead mode the fenced op HANGS (never raises), so it must run on a
+    watchdog thread: the main thread heartbeats while a single probe thread blocks
+    in backend init; when the tunnel recovers, that same blocked call completes and
+    flips the event. A raised error restarts the probe thread."""
+    import threading
+
     import jax.numpy as jnp
 
     t0 = time.time()
-    attempt = 0
-    while time.time() - t0 < MAX_WAIT_MIN * 60:
-        attempt += 1
+    done = threading.Event()
+    state = {}
+
+    def probe():
         try:
             np.asarray(jnp.ones((4,)) + 1)  # fenced: device->host
-            emit(OUT, {"section": "meta", "event": "backend_up", "attempt": attempt,
-                       "waited_s": round(time.time() - t0, 1)})
-            return True
+            state["ok"] = True
         except Exception as e:
+            state["err"] = str(e)[:120]
+        done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    beats = 0
+    while time.time() - t0 < MAX_WAIT_MIN * 60:
+        if done.wait(timeout=60):
+            if state.get("ok"):
+                emit(OUT, {"section": "meta", "event": "backend_up",
+                           "waited_s": round(time.time() - t0, 1)})
+                return True
             emit(OUT, {"section": "meta", "event": "probe_error",
-                       "error": str(e)[:120]})
-        time.sleep(20)
+                       "error": state.get("err", "?")})
+            done.clear()
+            state.clear()
+            time.sleep(20)
+            threading.Thread(target=probe, daemon=True).start()
+        else:
+            beats += 1
+            if beats % 10 == 0:
+                emit(OUT, {"section": "meta", "event": "still_waiting",
+                           "waited_s": round(time.time() - t0, 1)})
     return False
 
 
