@@ -147,7 +147,7 @@ def probe_backend(timeout_s: float | None = None) -> tuple[str | None, str]:
     import threading
 
     if timeout_s is None:
-        timeout_s = float(os.environ.get("DLT_PROBE_TIMEOUT", 300))
+        timeout_s = float(os.environ.get("DLT_PROBE_TIMEOUT", 600))
 
     got: list[str] = []
     err: list[str] = []
