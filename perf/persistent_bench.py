@@ -80,6 +80,13 @@ def wait_for_backend() -> bool:
                 return True
             emit(OUT, {"section": "meta", "event": "probe_error",
                        "error": state.get("err", "?")})
+            try:
+                # a FAILED init is cached per process; reset so the retry re-inits
+                import jax.extend.backend
+
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
             done.clear()
             state.clear()
             time.sleep(20)
