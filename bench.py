@@ -251,10 +251,12 @@ def main():
         (BENCH_r03.json). Capture the message only, clear the traceback, and
         gc.collect() before re-synthesizing."""
         ladder = [(layout, args.cache_write)]
-        if layout == "i4p":
-            ladder.append(("i8", args.cache_write))
         if args.cache_write != "inscan":
-            ladder.append(("i8" if layout == "i4p" else layout, "inscan"))
+            # deferred/fused-attention failure: keep the better 4-bit layout
+            ladder.append((layout, "inscan"))
+        if layout == "i4p":
+            # q4-kernel failure: the proven int8-plane path
+            ladder.append(("i8", "inscan"))
         reasons = []
         for attempt, (lay, cw) in enumerate(ladder):
             state["cache_write"] = cw
