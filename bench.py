@@ -237,11 +237,13 @@ def main():
 
     def compile_with_fallback(make_and_warm):
         """Build + compile down a degradation ladder so an unattended driver run
-        records a downgraded number (with fallback_reason) instead of crashing:
+        records a downgraded number (with fallback_reason) instead of crashing.
+        With the defaults (i4p, deferred) the rungs are:
 
-            (requested layout, requested cache_write)
-            -> (i8, requested cache_write)      # 4-bit kernel failed to lower
-            -> (i8, inscan)                     # deferred path / fused attention failed
+            (i4p, deferred)
+            -> (i4p, inscan)   # deferred path / fused attention failed to lower
+            -> (i8, deferred)  # the 4-bit kernel failed to lower
+            -> (i8, inscan)    # both failed
 
         Each failed attempt's parameter set must be FULLY dropped before the next so
         peak HBM holds one set. `state.pop("params")` alone is not enough: the caught
@@ -255,7 +257,9 @@ def main():
             # deferred/fused-attention failure: keep the better 4-bit layout
             ladder.append((layout, "inscan"))
         if layout == "i4p":
-            # q4-kernel failure: the proven int8-plane path
+            if args.cache_write != "inscan":
+                # q4-kernel failure alone: keep the deferred discipline
+                ladder.append(("i8", args.cache_write))
             ladder.append(("i8", "inscan"))
         reasons = []
         for attempt, (lay, cw) in enumerate(ladder):
@@ -323,6 +327,7 @@ def main():
             "metric": metric_name(args), "value": round(tok_s, 1), "unit": "tok/s",
             "vs_baseline": vs_baseline(args, tok_s),
             "chunk": t_chunk, "weight_gb": round(state["wbytes"] / 1e9, 3),
+            "layout": state["layout"], "cache_write": state["cache_write"],
             "ms_per_chunk": round(dt_all / n_disp * 1e3, 2),
         }
         if "fallback_reason" in state:
