@@ -74,7 +74,7 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                         "'expert' shards WHOLE experts (each chip owns E/tp experts "
                         "— the capacity axis for Grok-1-314B-class expert weights; "
                         "requires n_experts %% tp == 0)")
-    p.add_argument("--cache-write", default="deferred",
+    p.add_argument("--cache-write", default=None,
                    choices=["deferred", "inscan"],
                    help="KV cache discipline (models/forward.py): 'deferred' keeps "
                         "the caches loop-invariant in the layer scan and commits new "

@@ -103,6 +103,7 @@ class BatchEngine:
         self._pending: list[BatchRequest] = []
         self._plock = threading.Lock()
         self.prefilled_tokens = 0  # observability: total tokens run through prefill
+        self.decode_steps = 0  # observability: batched device decode dispatches
         self._wake = threading.Event()
         self._shutdown = False
         self._thread: threading.Thread | None = None
@@ -366,6 +367,7 @@ class BatchEngine:
             starts[slot.index] = slot.pos
             rows[slot.index] = [slot.last_token]
         logits = self._step(rows, starts, 1)
+        self.decode_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
         for slot in active:
             slot.last_logits = logits[slot.index, -1]

@@ -84,7 +84,7 @@ class Engine:
                  *, tp: int | None = None, sp: int = 1, dp: int = 1, dtype=None,
                  use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1,
-                 pod: bool = False, cache_write: str = "deferred",
+                 pod: bool = False, cache_write: str | None = None,
                  moe_sharding: str = "slice"):
         self.spec = spec
         self.tokenizer = tokenizer
@@ -121,7 +121,15 @@ class Engine:
         # XLA TPU inserts for dynamically-indexed carry updates (round-4 trace:
         # ~11.6 ms/token at 7B). "inscan" is the per-layer in-place form (required
         # with sp: ring attention owns its cache update).
-        self.cache_write = "inscan" if sp > 1 else cache_write
+        # None = auto: deferred unless sp forces inscan. Warn only on an EXPLICIT
+        # deferred request being overridden, not on the auto default.
+        if sp > 1 and cache_write == "deferred":
+            import sys
+
+            print("⚠️  cache_write=deferred is not supported with --sp (ring "
+                  "attention owns its cache update); using inscan",
+                  file=sys.stderr, flush=True)
+        self.cache_write = "inscan" if sp > 1 else (cache_write or "deferred")
         # MoE expert placement: "slice" TP-slices every expert's hidden axis (the
         # reference's scheme); "expert" shards WHOLE experts over tp — the capacity
         # axis for Grok-1-314B-class expert weights (parallel/sharding.py)

@@ -1,0 +1,64 @@
+# Shared helpers for the perf shell runbooks (sourced by sweep.sh / r5_hw.sh).
+# Requires $OUT to be set by the sourcing script. Every emitted line is valid
+# JSON; a command that dies leaves an explicit {"section":"error",...} record
+# carrying the tail of its stderr (diagnosable, not just 'failed/hung').
+
+note() {
+    python -c "import json,sys;print(json.dumps({'section':'cmd','argv':sys.argv[1]}))" "$*" | tee -a "$OUT"
+}
+
+err_record() {  # $1=argv  $2=stderr-file
+    python - "$1" "$2" <<'PY' | tee -a "$OUT"
+import json, sys
+tail = ""
+try:
+    with open(sys.argv[2], errors="replace") as f:
+        tail = " | ".join(l.strip() for l in f.readlines()[-3:] if l.strip())[:500]
+except OSError:
+    pass
+print(json.dumps({"section": "error", "argv": sys.argv[1],
+                  "error": "command failed, hung (watchdog), or produced no output",
+                  "stderr_tail": tail}))
+PY
+}
+
+# pause the warm runner for any TPU job this script launches (microbench etc.
+# don't write the sentinel themselves; concurrent jobs wedge the tunnel).
+# The path mirrors bench.py's SENTINEL constant — keep the two in sync.
+touch_sentinel() {
+    python -c "import time;open('perf/.driver_bench_active','w').write(str(time.time()))" 2>/dev/null || true
+}
+
+# watchdog: must budget for bench.py's pre-measurement waits (busy-wait for the
+# warm runner to yield, up to DLT_BUSY_WAIT=1500s, + probe up to
+# DLT_PROBE_TIMEOUT=600s) on top of the measurement itself
+WATCHDOG_S=3600
+
+# run CMD...: emit cmd record, run under the watchdog, record the LAST stdout
+# line (bench.py's JSON) or an error record with stderr tail
+run() {
+    note "$*"
+    touch_sentinel
+    local line etmp
+    etmp=$(mktemp)
+    if line=$(timeout "$WATCHDOG_S" "$@" 2>"$etmp" | tail -1) && [ -n "$line" ]; then
+        echo "$line" | tee -a "$OUT"
+    else
+        err_record "$*" "$etmp"
+    fi
+    rm -f "$etmp"
+}
+
+# run_all CMD...: same, but records EVERY stdout line (multi-record sections)
+run_all() {
+    note "$*"
+    touch_sentinel
+    local out etmp
+    etmp=$(mktemp)
+    if out=$(timeout "$WATCHDOG_S" "$@" 2>"$etmp") && [ -n "$out" ]; then
+        echo "$out" | tee -a "$OUT"
+    else
+        err_record "$*" "$etmp"
+    fi
+    rm -f "$etmp"
+}

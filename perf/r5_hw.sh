@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-5 hardware runbook — manual/backup path for the full measurement
+# sequence, serialized (concurrent TPU jobs wedge the axon tunnel; PROFILE.md).
+# The PRIMARY capture path this round is perf/persistent_bench.py, which runs
+# the same matrix in-process against a warm backend and publishes the headline
+# to BENCH_latest.json for the driver handoff; use this script when a human (or
+# a fresh shell) wants the sweep without the warm runner.
+#   bash perf/r5_hw.sh [outfile]
+set -o pipefail
+# shared run()/err_record() helpers; resolve before the cd so any invocation cwd works
+source "$(cd "$(dirname "$0")" && pwd)/_bench_lib.sh"
+cd "$(dirname "$0")/.."
+OUT="${1:-perf/r5_hw_results.jsonl}"
+: > "$OUT"
+
+# 1. headline with the deferred cache discipline (default)
+run python bench.py --steps 32
+# 2. cache-write A/B: the carry-copy question
+run python bench.py --steps 32 --cache-write inscan
+# 3. device-loop amortization
+run python bench.py --steps 32 --device-loop 8
+run python bench.py --steps 64 --device-loop 32
+# 4. prefill at two chunk sizes
+run python bench.py --prefill 64 --steps 16
+run python bench.py --prefill 128 --steps 16
+# 5. forced-failure fallback drill (must print an i8 line with fallback_reason)
+run env DLT_FORCE_I4P_FAILURE=1 python bench.py --steps 4
+# 6. the full sweep (window sweep, other archs, microbench, collectives)
+bash perf/sweep.sh
+echo "r5 hw runbook complete -> $OUT + perf/sweep_results.jsonl"

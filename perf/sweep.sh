@@ -9,46 +9,12 @@
 # records, not '#' comments), and a command that dies still leaves an explicit
 # {"section":"error",...} record instead of silently vanishing from the file.
 set -e -o pipefail
+# shared run()/run_all()/err_record() helpers (watchdog + stderr-tail records);
+# resolve before the cd so any invocation cwd works
+source "$(cd "$(dirname "$0")" && pwd)/_bench_lib.sh"
 cd "$(dirname "$0")/.."
 OUT="${1:-perf/sweep_results.jsonl}"
 : > "$OUT"
-
-run() {
-    python - "$*" <<'PY' | tee -a "$OUT"
-import json, sys
-print(json.dumps({"section": "cmd", "argv": sys.argv[1]}))
-PY
-    local line
-    if line=$(timeout 1500 "$@" 2>/dev/null | tail -1) && [ -n "$line" ]; then
-        echo "$line" | tee -a "$OUT"
-    else
-        python - "$*" <<'PY' | tee -a "$OUT"
-import json, sys
-print(json.dumps({"section": "error", "argv": sys.argv[1],
-                  "error": "command failed, hung (1500s watchdog), or produced no output"}))
-PY
-    fi
-}
-
-# multi-line sections run under the same watchdog/error-record discipline as run():
-# a wedged tunnel (the documented outage mode) must neither hang the sweep nor
-# vanish silently from the output
-run_all() {
-    python - "$*" <<'PY' | tee -a "$OUT"
-import json, sys
-print(json.dumps({"section": "cmd", "argv": sys.argv[1]}))
-PY
-    local out
-    if out=$(timeout 1500 "$@" 2>/dev/null) && [ -n "$out" ]; then
-        echo "$out" | tee -a "$OUT"
-    else
-        python - "$*" <<'PY' | tee -a "$OUT"
-import json, sys
-print(json.dumps({"section": "error", "argv": sys.argv[1],
-                  "error": "command failed, hung (1500s watchdog), or produced no output"}))
-PY
-    fi
-}
 
 # platform characteristics (dispatch overhead, streaming ceiling, kernel GB/s,
 # windowed-vs-full attention) — includes the i4p vs i4p-inline vs i8 kernel A/B

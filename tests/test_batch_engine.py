@@ -7,6 +7,7 @@ dllama-api.cpp:418-429, so any ratio > 1 is already beyond parity), and (c) reus
 prefixes across requests on the same slot (the NaiveCache generalization).
 """
 
+import os
 import threading
 import time
 
@@ -54,6 +55,31 @@ def test_batched_matches_single_engine(setup):
         assert r.stats.generated_tokens == 10
 
 
+def test_two_concurrent_share_decode_steps(setup):
+    """2 concurrent requests must ride the SAME batched decode dispatches — the whole
+    point of continuous batching (the reference serializes, dllama-api.cpp:418-429).
+    Asserted on the scheduler's own dispatch counter, which is deterministic, rather
+    than wall-clock time on a shared CI host (the round-4 flake): 2 x n tokens must
+    cost ~n batched steps, not ~2n serialized ones. A small slack absorbs admission
+    skew (one request admitted a step before the other)."""
+    spec, params, be = setup
+    n = 24
+    sampler = lambda: Sampler(spec.vocab_size, temperature=0.0)
+
+    base = be.decode_steps
+    reqs = [be.submit([1, 4, 9 + i], n, sampler()) for i in range(2)]
+    for r in reqs:
+        out = r.wait(timeout=120)
+        assert len(out) == n
+    steps = be.decode_steps - base
+    # perfect sharing costs n-1 steps (token 1 comes from prefill logits; token n
+    # is sampled without a further dispatch); serialized would cost ~2(n-1)
+    assert n - 1 <= steps <= n + 6, (steps, n)
+
+
+@pytest.mark.skipif(not os.environ.get("DLT_TIMING_TESTS"),
+                    reason="wall-clock throughput assert is flaky on shared CPU "
+                           "hosts; set DLT_TIMING_TESTS=1 to run")
 def test_two_concurrent_beat_single_throughput(setup):
     """2 concurrent requests must finish in well under 2x one request's time (they
     share each decode step). Target from the round-3 verdict: >1.5x throughput."""
