@@ -113,10 +113,30 @@ def synth_q40(key, shape, layout: str):
     return QTensor(FloatType.Q40, packed, scales)
 
 
-def synth_params(spec: ModelSpec, layout: str):
+def synth_params(spec: ModelSpec, layout: str, fuse: bool = True, tp: int = 1):
+    from distributed_llama_tpu.models.params import _FUSE_GROUPS
+    from distributed_llama_tpu.parallel.sharding import effective_kv_heads
+
     key = jax.random.PRNGKey(0)
+    shapes = dict(block_tensor_shapes(spec))
+    if fuse:
+        # merged matvec groups: synthesize the fused shapes directly (random
+        # weights need no interleaving), derived from the canonical
+        # models/params.py _FUSE_GROUPS table so bench measures the same fusion
+        # production applies — including its eligibility rules (QKV fusion is
+        # skipped under KV-head replication, which rewrites wk/wv at shard time)
+        for fused_name, members in _FUSE_GROUPS.items():
+            if not all(n in shapes for n in members):
+                continue
+            if fused_name == "wqkv" and effective_kv_heads(spec, tp) != spec.n_kv_heads:
+                continue
+            rows = sum(shapes[n][0][0] for n in members)
+            in_dim = shapes[members[0]][0][1]
+            shapes[fused_name] = ((rows, in_dim), True)
+            for n in members:
+                del shapes[n]
     blocks = {}
-    for name, (shape, quantized) in block_tensor_shapes(spec).items():
+    for name, (shape, quantized) in shapes.items():
         key, sub = jax.random.split(key)
         full = (spec.n_layers, *shape)
         if quantized:
@@ -205,6 +225,9 @@ def main():
                          "of decode")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed region here")
+    ap.add_argument("--no-fuse", action="store_true",
+                    help="keep wq/wk/wv and w1/w3 as separate kernel launches "
+                         "instead of the merged wqkv/w13 groups (A/B lever)")
     args = ap.parse_args()
 
     if not os.environ.get("DLT_WARM_RUNNER") and os.environ.get("JAX_PLATFORMS") != "cpu":
@@ -261,7 +284,7 @@ def main():
         is_headline = all(
             getattr(args, k) == ap.get_default(k)
             for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
-                      "window", "cache_write")
+                      "window", "cache_write", "no_fuse")
         ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
         if is_headline and os.path.exists(HANDOFF_LATEST):
             try:
@@ -305,7 +328,8 @@ def main():
     state = {}
 
     def build(lay):
-        params = shard_params(synth_params(spec, lay), mesh, spec)
+        params = shard_params(
+            synth_params(spec, lay, fuse=not args.no_fuse, tp=args.tp), mesh, spec)
         state.update(params=params, layout=lay,
                      wbytes=decode_stream_bytes(params, spec))
         kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
@@ -484,6 +508,7 @@ def main():
         "cache_write": state["cache_write"],
         "attn_window": window or spec.seq_len,
         "device_loop": args.device_loop,
+        "fused": not args.no_fuse,
     }
     if "fallback_reason" in state:
         out["fallback_reason"] = state["fallback_reason"]

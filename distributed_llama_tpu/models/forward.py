@@ -96,9 +96,21 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
     hs = spec.head_size
     _, _, hk, s, _ = kc.shape
     xb = rmsnorm(x, bp["rms_att"], spec.norm_eps)
-    q = qmatmul(xb, bp["wq"], use_pallas=use_pallas)
-    k = qmatmul(xb, bp["wk"], use_pallas=use_pallas)
-    v = qmatmul(xb, bp["wv"], use_pallas=use_pallas)
+    if "wqkv" in bp:
+        # merged QKV (models/params.py fuse_matvec_groups): ONE kernel launch for
+        # all three projections. Local row counts split proportionally to the
+        # global dim : kv : kv ratio (exact — every term divides by tp).
+        qkv = qmatmul(xb, bp["wqkv"], use_pallas=use_pallas)
+        total = qkv.shape[-1]
+        lq = total * spec.dim // (spec.dim + 2 * spec.kv_dim)
+        lkv = (total - lq) // 2
+        q = qkv[..., :lq]
+        k = qkv[..., lq:lq + lkv]
+        v = qkv[..., lq + lkv:]
+    else:
+        q = qmatmul(xb, bp["wq"], use_pallas=use_pallas)
+        k = qmatmul(xb, bp["wk"], use_pallas=use_pallas)
+        v = qmatmul(xb, bp["wv"], use_pallas=use_pallas)
     hq_local = q.shape[-1] // hs
     hk_local = k.shape[-1] // hs
     q = apply_rope(q.reshape(b, t, hq_local, hs), rope, positions)
@@ -198,8 +210,15 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
 
 def _dense_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
     act = _act(spec)
-    h = act(qmatmul(xb, bp["w1"], use_pallas=use_pallas)) * qmatmul(
-        xb, bp["w3"], use_pallas=use_pallas)
+    if "w13" in bp:
+        # merged gate+up (fuse_matvec_groups): one launch, halves split evenly
+        # ([w1|w3] per TP group — both are (hidden, dim))
+        y = qmatmul(xb, bp["w13"], use_pallas=use_pallas)
+        hl = y.shape[-1] // 2
+        h = act(y[..., :hl]) * y[..., hl:]
+    else:
+        h = act(qmatmul(xb, bp["w1"], use_pallas=use_pallas)) * qmatmul(
+            xb, bp["w3"], use_pallas=use_pallas)
     return _maybe_psum(qmatmul(h, bp["w2"], use_pallas=use_pallas), axis_name, compress)
 
 

@@ -119,17 +119,89 @@ def _decode_layout(t: QTensor, tp: int, col_sharded: bool) -> QTensor:
     return t.to_i8_layout()
 
 
+def _concat_rows_grouped(tensors: list[QTensor], tp: int) -> QTensor:
+    """Concatenate planar QTensors along the row (out) axis, interleaved per TP
+    group: the result's rows are [t0_g0, t1_g0, ..., t0_g1, t1_g1, ...] where g_i
+    is shard i's row slice of each input, so a P('tp')-on-rows placement lands each
+    shard exactly its own inputs' slices, contiguous. Quant blocks run along the
+    *in* axis, so row concatenation never touches block structure (numerics are
+    bit-identical to the separate tensors)."""
+    ft = tensors[0].ftype
+    assert all(t.layout == "planar" and t.ftype == ft for t in tensors)
+
+    def cat(leaves):
+        # planar leaf shapes: data (L, out, nb, 16|32), scales (L, out, nb)
+        parts = []
+        for a in leaves:
+            rows = a.shape[1]
+            assert rows % tp == 0, (a.shape, tp)
+            parts.append(a.reshape(a.shape[0], tp, rows // tp, *a.shape[2:]))
+        return np.concatenate(parts, axis=2).reshape(
+            leaves[0].shape[0], -1, *leaves[0].shape[2:])
+
+    return QTensor(ft, cat([np.asarray(t.data) for t in tensors]),
+                   cat([np.asarray(t.scales) for t in tensors]))
+
+
+# merged matvec groups: members share the same activation vector, so one kernel
+# launch with the row blocks concatenated replaces 3 (QKV) / 2 (gate+up) launches
+# — fewer grid setups and quantize/Xexp prologues per layer. The reference has no
+# counterpart (its task lists issue one matmul task per tensor,
+# llama2-tasks.cpp:246-276); this is TPU launch-overhead engineering.
+_FUSE_GROUPS = {"wqkv": ("wq", "wk", "wv"), "w13": ("w1", "w3")}
+
+
+def fuse_matvec_groups(blocks: Params, spec: ModelSpec | None, tp: int) -> Params:
+    """Replace wq/wk/wv -> wqkv and w1/w3 -> w13 with row-concatenated (TP-group
+    interleaved) planar tensors where safe. Skipped per group when a member is not
+    kernel-convertible or (QKV) when KV-head replication is active (tp >
+    n_kv_heads expands wk/wv rows at shard time, after this runs)."""
+    from ..parallel.sharding import effective_kv_heads
+
+    out = dict(blocks)
+    for fused, members in _FUSE_GROUPS.items():
+        ts = [blocks.get(m) for m in members]
+        if not all(isinstance(t, QTensor) and t.layout == "planar"
+                   and _kernel_convertible(t, stacked=True) for t in ts):
+            continue
+        if len({t.ftype for t in ts}) != 1:
+            continue
+        if any(t.shape[1] % tp for t in ts):
+            continue
+        if fused == "wqkv":
+            if spec is None and tp > 1:
+                continue  # can't rule out KV replication without the spec
+            if spec is not None and effective_kv_heads(spec, tp) != spec.n_kv_heads:
+                continue  # replication rewrites wk/wv rows later; keep separate
+        out[fused] = _concat_rows_grouped(ts, tp)
+        for m in members:
+            del out[m]
+    return out
+
+
 def prepare_for_pallas(params: Params, tp: int = 1,
-                       moe_sharding: str = "slice") -> Params:
+                       moe_sharding: str = "slice",
+                       spec: ModelSpec | None = None,
+                       fuse: bool = True) -> Params:
     """Repack the dense matmul weights into the Pallas decode-kernel layouts
     (i4p packed nibbles for Q40, int8 planes for Q80). Row/col TP slices stay
     32-block-aligned; col-sharded tensors are packed per TP column group so each
     shard's slice is self-contained. Under expert sharding the MoE stacks shard by
-    whole experts, so their in-axes are NOT column-sliced and pack with groups=1."""
+    whole experts, so their in-axes are NOT column-sliced and pack with groups=1.
+
+    fuse=True additionally merges the QKV and gate/up matvec groups into single
+    row-concatenated tensors (fuse_matvec_groups) so decode launches one kernel
+    per group instead of one per tensor."""
+    import os
+
     out: Params = {"embedding": params["embedding"], "blocks": {},
                    "rms_final": params["rms_final"]}
-    for name, t in params["blocks"].items():
-        if name in _DENSE_MATMULS and _kernel_convertible(t, stacked=True):
+    fuse = fuse and not os.environ.get("DLT_NO_FUSE")  # field kill-switch
+    blocks = fuse_matvec_groups(params["blocks"], spec, tp) if fuse \
+        else params["blocks"]
+    for name, t in blocks.items():
+        if ((name in _DENSE_MATMULS or name in _FUSE_GROUPS)
+                and _kernel_convertible(t, stacked=True)):
             col = name in _COL_SHARDED and not (
                 moe_sharding == "expert" and name.startswith("moe_"))
             out["blocks"][name] = _decode_layout(t, tp, col)

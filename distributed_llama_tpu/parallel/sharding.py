@@ -32,6 +32,11 @@ _BLOCK_SPECS = {
     "wq": P(None, AXIS_TP),          # (L, dim->tp, dim)
     "wk": P(None, AXIS_TP),          # (L, kv_dim->tp, dim)
     "wv": P(None, AXIS_TP),
+    # merged matvec groups (models/params.py fuse_matvec_groups): rows are
+    # TP-group interleaved at fuse time, so plain row sharding lands each shard
+    # its own [q|k|v] / [gate|up] block
+    "wqkv": P(None, AXIS_TP),        # (L, (dim+2kv)->tp, dim)
+    "w13": P(None, AXIS_TP),         # (L, 2*hidden->tp, dim)
     "wo": P(None, None, AXIS_TP),    # (L, dim, dim->tp) partial-sum
     "w1": P(None, AXIS_TP),          # (L, hidden->tp, dim)
     "w3": P(None, AXIS_TP),

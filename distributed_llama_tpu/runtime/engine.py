@@ -140,7 +140,8 @@ class Engine:
         self.use_pallas = use_pallas and has_quant
         if self.use_pallas:
             params = prepare_for_pallas(params, self.tp,
-                                        moe_sharding=self.moe_sharding)
+                                        moe_sharding=self.moe_sharding,
+                                        spec=spec)
         self.params = shard_params(params, self.mesh, spec,
                                    moe_sharding=self.moe_sharding)
         # global (all-shard) weight bytes one decode step streams — per-chip traffic

@@ -46,6 +46,7 @@ KEEP_FRESH_HOURS = 14.0
 HEADLINE = ["--steps", "32"]
 CONFIGS = [
     HEADLINE,
+    ["--steps", "32", "--no-fuse"],
     ["--steps", "32", "--cache-write", "inscan"],
     ["--steps", "32", "--layout", "i8"],
     ["--steps", "32", "--device-loop", "8"],

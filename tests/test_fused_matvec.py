@@ -1,0 +1,150 @@
+"""Merged matvec groups (models/params.py fuse_matvec_groups): wq/wk/wv -> wqkv and
+w1/w3 -> w13, row-concatenated with per-TP-group interleaving so plain row sharding
+lands each shard its own [q|k|v] / [gate|up] block. One kernel launch per group
+replaces one per tensor on the decode path (launch-overhead engineering; the
+reference's task lists issue one matmul task per tensor, llama2-tasks.cpp:246-276).
+
+The interleaving is the risky part: these tests pin (a) bit-exact round-trip of the
+fused planar tensor against the members, (b) fused == unfused forward on the kernel
+path, (c) fused == planar under a real tp=2 shard_map (wrong group order would
+scramble heads on shard 1+ and fail loudly here)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_llama_tpu.models.forward import forward, init_kv_cache
+from distributed_llama_tpu.models.params import (fuse_matvec_groups,
+                                                 init_random_params,
+                                                 prepare_for_pallas)
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.ops.rope import RopeTables
+from distributed_llama_tpu.quants import FloatType
+
+
+def _spec():
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=16,
+                     rope_type=RopeType.LLAMA).resolved()
+
+
+def test_fused_tensor_roundtrip_tp_groups():
+    """Dequantized wqkv rows must be exactly the members' rows in TP-group
+    interleaved order: [q_g0, k_g0, v_g0, q_g1, k_g1, v_g1] for tp=2."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=5)
+    tp = 2
+    fused = fuse_matvec_groups(params["blocks"], spec, tp)
+    got = fused["wqkv"].to_numpy()
+
+    q = params["blocks"]["wq"].to_numpy()
+    k = params["blocks"]["wk"].to_numpy()
+    v = params["blocks"]["wv"].to_numpy()
+    rows = []
+    for g in range(tp):
+        for m in (q, k, v):
+            r = m.shape[1] // tp
+            rows.append(m[:, g * r:(g + 1) * r])
+    want = np.concatenate(rows, axis=1)
+    np.testing.assert_array_equal(got, want)
+
+    # w13: [w1_g0, w3_g0, w1_g1, w3_g1]
+    got13 = fused["w13"].to_numpy()
+    w1 = params["blocks"]["w1"].to_numpy()
+    w3 = params["blocks"]["w3"].to_numpy()
+    rows = []
+    for g in range(tp):
+        for m in (w1, w3):
+            r = m.shape[1] // tp
+            rows.append(m[:, g * r:(g + 1) * r])
+    np.testing.assert_array_equal(got13, np.concatenate(rows, axis=1))
+
+
+def test_fused_forward_matches_unfused_kernel_path():
+    """Same kernels, merged launches: fused vs unfused pallas decode must agree to
+    float tolerance (identical quantized weights, identical activation Q80 path)."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=7)
+    rope = RopeTables.create(spec)
+    unfused = prepare_for_pallas(params, fuse=False)
+    fused = prepare_for_pallas(params, spec=spec)
+    assert "wqkv" in fused["blocks"] and "wqkv" not in unfused["blocks"]
+
+    for tokens in (jnp.asarray([[1, 2, 3]]), jnp.asarray([[5]])):
+        kc, vc = init_kv_cache(spec)
+        want, _, _ = forward(unfused, spec, rope, tokens, kc, vc, jnp.int32(0),
+                             use_pallas=True)
+        kc, vc = init_kv_cache(spec)
+        got, _, _ = forward(fused, spec, rope, tokens, kc, vc, jnp.int32(0),
+                            use_pallas=True)
+        got, want = np.asarray(got), np.asarray(want)
+        rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+        assert rel < 1e-5, rel
+
+
+def test_fused_sharded_forward_matches_planar():
+    """tp=2 shard_map over fused params: wrong group interleaving would hand shard 1
+    rows belonging to shard 0's heads and diverge immediately."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
+                                                   make_sharded_forward,
+                                                   shard_params)
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=3)
+    mesh = make_mesh(tp=2)
+    tokens = jnp.asarray([[1, 2, 3]])
+    rope = RopeTables.create(spec)
+
+    base = shard_params(params, mesh, spec)
+    step = make_sharded_forward(spec, mesh, base, donate_cache=False)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    want, _, _ = step(base, rope, tokens, kc, vc, jnp.int32(0))
+
+    pp = shard_params(prepare_for_pallas(params, tp=2, spec=spec), mesh, spec)
+    assert "wqkv" in pp["blocks"] and "w13" in pp["blocks"]
+    stepp = make_sharded_forward(spec, mesh, pp, donate_cache=False)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    got, _, _ = stepp(pp, rope, tokens, kc, vc, jnp.int32(0))
+    # prefill rides the XLA dequant path: i4p dequant matches planar exactly
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_fused_decode_sharded_kernel_path():
+    """tp=2 decode (T=1) through the merged kernels under shard_map vs the planar
+    sharded step — kernel path at Q80 activation-quantization error scale."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
+                                                   make_sharded_forward,
+                                                   shard_params)
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    mesh = make_mesh(tp=2)
+    tok = jnp.asarray([[5]])
+    rope = RopeTables.create(spec)
+
+    base = shard_params(params, mesh, spec)
+    step = make_sharded_forward(spec, mesh, base, donate_cache=False)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    want, _, _ = step(base, rope, tok, kc, vc, jnp.int32(0))
+
+    pp = shard_params(prepare_for_pallas(params, tp=2, spec=spec), mesh, spec)
+    stepp = make_sharded_forward(spec, mesh, pp, use_pallas=True,
+                                 donate_cache=False)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    got, _, _ = stepp(pp, rope, tok, kc, vc, jnp.int32(0))
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 0.03, rel
+
+
+def test_fuse_skipped_under_kv_replication():
+    """tp > n_kv_heads engages KV-head row replication (parallel/tp.py), which
+    rewrites wk/wv AFTER fusion would run — fuse must decline and leave the
+    separate tensors for the replication path."""
+    spec = _spec()  # n_kv_heads=2
+    params = init_random_params(spec, FloatType.Q40, seed=2)
+    fused = fuse_matvec_groups(params["blocks"], spec, tp=4)
+    assert "wqkv" not in fused and "wq" in fused
+    assert "w13" in fused  # gate/up has no replication concern

@@ -103,13 +103,17 @@ def test_prepare_for_pallas_picks_i4p_for_q40():
                      n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=16,
                      rope_type=RopeType.LLAMA).resolved()
     params = init_random_params(spec, FloatType.Q40, seed=7)
-    pp = prepare_for_pallas(params, tp=2)
-    assert pp["blocks"]["wq"].layout == "i4p" and pp["blocks"]["wq"].groups == 1
+    pp = prepare_for_pallas(params, tp=2, spec=spec)
+    # QKV and gate/up merge into single row-concatenated tensors (fuse_matvec_groups)
+    assert pp["blocks"]["wqkv"].layout == "i4p" and pp["blocks"]["wqkv"].groups == 1
+    assert pp["blocks"]["wqkv"].shape[1] == spec.dim + 2 * spec.kv_dim
+    assert pp["blocks"]["w13"].shape[1] == 2 * spec.hidden_dim
+    assert "wq" not in pp["blocks"] and "w1" not in pp["blocks"]
     assert pp["blocks"]["w2"].layout == "i4p" and pp["blocks"]["w2"].groups == 2
     assert pp["wcls"].layout == "i4p"
     # Q80 weights keep the int8-plane layout (no 4-bit repack possible)
     p80 = prepare_for_pallas(init_random_params(spec, FloatType.Q80, seed=7), tp=1)
-    assert p80["blocks"]["wq"].layout == "i8"
+    assert p80["blocks"]["wqkv"].layout == "i8"
 
 
 def test_sharded_forward_with_i4p_params():
