@@ -79,8 +79,8 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                    help="KV cache discipline (models/forward.py): 'deferred' keeps "
                         "the caches loop-invariant in the layer scan and commits new "
                         "rows in one top-level write (avoids XLA TPU's whole-cache "
-                        "carry copies); 'inscan' is the per-layer in-place form "
-                        "(automatic under --sp)")
+                        "carry copies; works with --sp too); 'inscan' is the "
+                        "per-layer in-place form")
     p.add_argument("--device-loop", type=int, default=0, metavar="CHUNK",
                    help="decode CHUNK tokens per dispatch with the on-device scan loop "
                         "(runtime/device_loop.py); 0 = per-token host loop")

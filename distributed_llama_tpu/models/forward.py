@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import gelu_tanh, rmsnorm, silu
 from ..ops.matmul import qmatmul
-from ..ops.ring_attention import ring_attention, update_kv_cache_sharded
+from ..ops.ring_attention import (commit_kv_rows_sharded, ring_attention,
+                                  update_kv_cache_sharded)
 from ..ops.rope import RopeTables, apply_rope
 from .spec import ArchType, HiddenAct, ModelSpec
 
@@ -118,9 +119,28 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
     v = v.reshape(b, t, hk_local, hs)
     if sp_axis_name is not None and sp_size > 1:
         # sequence parallelism: each sp member keeps its slice of the cache and the
-        # KV blocks rotate around the ring (ops/ring_attention.py). Layer slice out,
-        # sharded update, full-layer write-back (the ring path reads the whole local
-        # slice anyway).
+        # KV blocks rotate around the ring (ops/ring_attention.py).
+        if deferred_write:
+            # deferred discipline on the sp path: the sharded caches stay
+            # loop-invariant (read-only — no full-local-slice carry copies); the
+            # ring attends COMMITTED rows only (live_end) plus the current
+            # chunk's K/V as a register block, and the new rows ride out as scan
+            # ys for forward() to commit with ONE masked window write per cache
+            # (ops/ring_attention.py commit_kv_rows_sharded).
+            k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)  # (B, hk, T, hs)
+            v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
+            kl = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0),
+                                       (1, b, hk, s, hs))[0]
+            vl = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0),
+                                       (1, b, hk, s, hs))[0]
+            att = ring_attention(q, kl, vl, positions, axis_name=sp_axis_name,
+                                 axis_size=sp_size, live_end=start_pos,
+                                 chunk=(k_t, v_t, start_pos))
+            attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas),
+                                   axis_name, compress)
+            return attn_out, (k_t, v_t)  # new rows only; caller commits post-scan
+        # in-scan form: layer slice out, sharded update, full-layer write-back
+        # (the ring path reads the whole local slice anyway)
         kl = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
         vl = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
         kl, vl = update_kv_cache_sharded(kl, vl, k, v, start_pos,
@@ -429,8 +449,10 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
       Motivation: the round-4 TPU trace shows the in-scan carries being copied
       whole at the step boundary (~11.6 ms/token at 7B) — XLA TPU's in-place
       while-buffer optimization does not fire for a carry that is
-      dynamic-update-sliced at a loop-varying index. Not supported with sp
-      (ring attention keeps its own full-slice update).
+      dynamic-update-sliced at a loop-varying index. Under sp the same
+      discipline applies to the sequence-sharded caches: the ring attends
+      committed rows + the chunk's K/V as a register block, and the commit is
+      a masked window write into the owning shard (commit_kv_rows_sharded).
 
     attn_window: static bound on cache positions attention reads (must cover
     start_pos + T). None reads the full seq_len. Callers bucket it (Engine) so decode
@@ -453,8 +475,8 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
         x = x * GROK_EMBEDDING_SCALE
 
     assert cache_write in ("inscan", "deferred"), cache_write
-    deferred = cache_write == "deferred" and not (
-        sp_axis_name is not None and sp_size > 1)
+    deferred = cache_write == "deferred"
+    sp_active = sp_axis_name is not None and sp_size > 1
     block_fn = functools.partial(_block, spec=spec, rope=rope, start_pos=start_pos,
                                  positions=positions, axis_name=axis_name,
                                  sp_axis_name=sp_axis_name, sp_size=sp_size,
@@ -468,7 +490,12 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             block_fn, x, (params["blocks"], layer_ids))
         # commit all layers' new rows in one write per cache: (L, B, hk, T, hs)
         # lands at [.., .., .., start_pos : start_pos+T, ..]
-        if start_pos.ndim == 0:
+        if sp_active:
+            # sequence-sharded caches: masked window write into the owning shard
+            k_cache, v_cache = commit_kv_rows_sharded(
+                k_cache, v_cache, k_rows, v_rows, start_pos,
+                axis_name=sp_axis_name)
+        elif start_pos.ndim == 0:
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache, k_rows, (0, 0, 0, start_pos, 0))
             v_cache = jax.lax.dynamic_update_slice(

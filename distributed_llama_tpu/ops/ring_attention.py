@@ -24,11 +24,14 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _block_attend(qg, k_blk, v_blk, positions, global_start):
+def _block_attend(qg, k_blk, v_blk, positions, global_start, live_end=None):
     """Masked scores + unnormalized accumulation for one KV block.
 
     qg: (B, hk, g, T, hs) f32; k_blk/v_blk: (B, hk, Sb, hs); positions: (T,) absolute
     query positions; global_start: absolute position of the block's first column.
+    live_end, if given, additionally masks columns at positions >= live_end —
+    the deferred-write discipline attends cache blocks only over COMMITTED rows
+    (the current chunk arrives as its own register block instead).
     Returns (m (…, T), l (…, T), acc (…, T, hs)) partial softmax stats.
     """
     sb = k_blk.shape[2]
@@ -38,6 +41,8 @@ def _block_attend(qg, k_blk, v_blk, positions, global_start):
                         k_blk.astype(jnp.float32)) * scale  # (B, hk, g, T, Sb)
     col_pos = global_start + jnp.arange(sb)  # absolute positions of block columns
     valid = col_pos[None, :] <= positions[:, None]  # (T, Sb) causal
+    if live_end is not None:
+        valid = valid & (col_pos[None, :] < live_end)
     scores = jnp.where(valid[None, None, None], scores, _NEG_INF)
     m = jnp.max(scores, axis=-1)  # (B, hk, g, T)
     # guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1, so clamp m
@@ -59,12 +64,21 @@ def _combine(m1, l1, acc1, m2, l2, acc2):
 
 
 def ring_attention(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
-                   positions: jax.Array, *, axis_name: str, axis_size: int) -> jax.Array:
+                   positions: jax.Array, *, axis_name: str, axis_size: int,
+                   live_end: jax.Array | None = None,
+                   chunk: tuple[jax.Array, jax.Array, jax.Array] | None = None,
+                   ) -> jax.Array:
     """Causal GQA attention of T query tokens against a sequence-sharded cache.
 
     q: (B, T, hq, hs) replicated over sp; k_shard/v_shard: (B, hk, S/sp, hs), the local
     sequence shard (device i holds absolute positions [i*Sb, (i+1)*Sb)). Returns
     (B, T, hq*hs), replicated over sp.
+
+    Deferred-write mode (models/forward.py cache_write="deferred"): the cache holds
+    only COMMITTED rows (positions < live_end == start_pos); the current chunk's
+    K/V ride in as `chunk=(k_c (B, hk, T, hs), v_c, chunk_start)` and are attended
+    as one extra register block folded into the same online softmax — no cache
+    write happens inside the step at all.
     """
     b, t, hq, hs = q.shape
     _, hk, sb, _ = k_shard.shape
@@ -81,14 +95,70 @@ def ring_attention(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
     k_blk, v_blk = k_shard, v_shard
     for r in range(axis_size):
         owner = (idx + r) % axis_size  # whose shard I currently hold
-        mb, lb, ab = _block_attend(qg, k_blk, v_blk, positions, owner * sb)
+        mb, lb, ab = _block_attend(qg, k_blk, v_blk, positions, owner * sb,
+                                   live_end=live_end)
         m, l, acc = _combine(m, l, acc, mb, lb, ab)
         if r + 1 < axis_size:
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    if chunk is not None:
+        k_c, v_c, chunk_start = chunk
+        mb, lb, ab = _block_attend(qg, k_c, v_c, positions, chunk_start)
+        m, l, acc = _combine(m, l, acc, mb, lb, ab)
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, hk, g, T, hs)
     out = jnp.moveaxis(out, 3, 1)  # (B, T, hk, g, hs)
     return out.reshape(b, t, hq * hs).astype(q.dtype)
+
+
+def commit_kv_rows_sharded(k_cache: jax.Array, v_cache: jax.Array,
+                           k_rows: jax.Array, v_rows: jax.Array,
+                           start_pos: jax.Array, *, axis_name: str
+                           ) -> tuple[jax.Array, jax.Array]:
+    """Deferred-write commit for sequence-sharded caches: write ALL layers' new
+    rows in one tiny masked window write per cache.
+
+    caches: (L, B, hk, Sb, hs) local shards; rows: (L, B, hk, T, hs) (every sp
+    member computed identical rows — activations are sp-replicated). The write
+    window is T wide at the chunk's local offset, clipped into the shard, with a
+    per-slot hit mask so a chunk straddling a shard boundary writes its prefix
+    on one member and its suffix on the next. Total write traffic is O(L·T)
+    rows — the sp counterpart of forward()'s top-level dynamic_update_slice,
+    replacing the full-local-cache carry the in-scan discipline pays."""
+    t = k_rows.shape[3]
+    sb = k_cache.shape[3]
+    idx = jax.lax.axis_index(axis_name)
+    local = start_pos - idx * sb  # chunk start in MY shard coordinates (may be <0)
+
+    if t > sb:
+        # prefill chunk wider than a shard (tiny seq_len/sp): masked scatter over
+        # the whole local shard — a full-shard write, but amortized over >= sb
+        # prefill tokens and unreachable from decode (T=1)
+        slot = jnp.arange(sb)
+        src = slot - local
+        hit = (src >= 0) & (src < t)
+        src_c = jnp.clip(src, 0, t - 1)
+
+        def write_full(cache, rows):
+            gathered = jnp.take(rows.astype(cache.dtype), src_c, axis=3)
+            return jnp.where(hit[None, None, None, :, None], gathered, cache)
+
+        return write_full(k_cache, k_rows), write_full(v_cache, v_rows)
+
+    at = jnp.clip(local, 0, sb - t)
+    win_slot = at + jnp.arange(t)  # absolute local slots of the write window
+    src = win_slot - local  # which chunk token lands in each window slot
+    hit = (src >= 0) & (src < t)
+    src_c = jnp.clip(src, 0, t - 1)
+
+    def write(cache, rows):
+        rows = rows.astype(cache.dtype)
+        cur = jax.lax.dynamic_slice(
+            cache, (0, 0, 0, at, 0), (*cache.shape[:3], t, cache.shape[4]))
+        gathered = jnp.take(rows, src_c, axis=3)
+        val = jnp.where(hit[None, None, None, :, None], gathered, cur)
+        return jax.lax.dynamic_update_slice(cache, val, (0, 0, 0, at, 0))
+
+    return write(k_cache, k_rows), write(v_cache, v_rows)
 
 
 def update_kv_cache_sharded(k_cache: jax.Array, v_cache: jax.Array, k_new: jax.Array,

@@ -119,17 +119,11 @@ class Engine:
         # KV cache discipline (models/forward.py): "deferred" keeps the caches
         # loop-invariant in the layer scan — avoids the whole-cache carry copies
         # XLA TPU inserts for dynamically-indexed carry updates (round-4 trace:
-        # ~11.6 ms/token at 7B). "inscan" is the per-layer in-place form (required
-        # with sp: ring attention owns its cache update).
-        # None = auto: deferred unless sp forces inscan. Warn only on an EXPLICIT
-        # deferred request being overridden, not on the auto default.
-        if sp > 1 and cache_write == "deferred":
-            import sys
-
-            print("⚠️  cache_write=deferred is not supported with --sp (ring "
-                  "attention owns its cache update); using inscan",
-                  file=sys.stderr, flush=True)
-        self.cache_write = "inscan" if sp > 1 else (cache_write or "deferred")
+        # ~11.6 ms/token at 7B). Supported on every path, including sp (the ring
+        # attends committed rows + the chunk as a register block, and the commit
+        # is a masked window write — commit_kv_rows_sharded). None = auto
+        # (deferred).
+        self.cache_write = cache_write or "deferred"
         # MoE expert placement: "slice" TP-slices every expert's hidden axis (the
         # reference's scheme); "expert" shards WHOLE experts over tp — the capacity
         # axis for Grok-1-314B-class expert weights (parallel/sharding.py)

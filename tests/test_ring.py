@@ -87,9 +87,12 @@ def _tiny_spec():
                      rope_type=RopeType.LLAMA).resolved()
 
 
-def test_forward_sp_tp_equals_unsharded():
+@pytest.mark.parametrize("cache_write", ["inscan", "deferred"])
+def test_forward_sp_tp_equals_unsharded(cache_write):
     """Full model on a 2x2 (sp x tp) mesh == single-device forward: prefill then a
-    decode step continuing from the sharded cache."""
+    decode step continuing from the sharded cache. Both cache disciplines — the
+    deferred form keeps the sequence-sharded caches loop-invariant and commits via
+    the masked window write (commit_kv_rows_sharded)."""
     spec = _tiny_spec()
     params = init_random_params(spec, FloatType.F32, seed=3)
     rope = RopeTables.create(spec)
@@ -102,12 +105,71 @@ def test_forward_sp_tp_equals_unsharded():
 
     mesh = make_mesh(sp=2, tp=2)
     sparams = shard_params(params, mesh, spec)
-    step = make_sharded_forward(spec, mesh, sparams, donate_cache=False)
+    step = make_sharded_forward(spec, mesh, sparams, donate_cache=False,
+                                cache_write=cache_write)
     kc, vc = init_sharded_kv_cache(spec, mesh)
     got, gkc, gvc = step(sparams, rope, tokens, kc, vc, jnp.int32(0))
     got2, _, _ = step(sparams, rope, jnp.asarray([[3]]), gkc, gvc, jnp.int32(8))
 
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_sp_deferred_cache_state_matches_inscan():
+    """After prefill + a boundary-straddling chunk + a decode step, the deferred
+    discipline must leave the sequence-sharded caches byte-identical to inscan
+    (same committed rows, same shard placement)."""
+    spec = _tiny_spec()  # seq_len=32, sp=2 -> shard size 16
+    params = init_random_params(spec, FloatType.F32, seed=9)
+    rope = RopeTables.create(spec)
+    mesh = make_mesh(sp=2, tp=2)
+    sparams = shard_params(params, mesh, spec)
+
+    caches = {}
+    for cw in ("inscan", "deferred"):
+        step = make_sharded_forward(spec, mesh, sparams, donate_cache=False,
+                                    cache_write=cw)
+        kc, vc = init_sharded_kv_cache(spec, mesh)
+        # prefill 12, then a 8-token chunk at 12..20 (straddles the shard
+        # boundary at 16), then a decode step at 20
+        _, kc, vc = step(sparams, rope, jnp.asarray([list(range(1, 13))]), kc, vc,
+                         jnp.int32(0))
+        _, kc, vc = step(sparams, rope, jnp.asarray([list(range(20, 28))]), kc, vc,
+                         jnp.int32(12))
+        _, kc, vc = step(sparams, rope, jnp.asarray([[3]]), kc, vc, jnp.int32(20))
+        caches[cw] = (np.asarray(kc), np.asarray(vc))
+
+    # committed region [0, 21) must agree exactly; beyond it is unwritten scratch
+    np.testing.assert_allclose(caches["deferred"][0][:, :, :, :21],
+                               caches["inscan"][0][:, :, :, :21], atol=1e-6)
+    np.testing.assert_allclose(caches["deferred"][1][:, :, :, :21],
+                               caches["inscan"][1][:, :, :, :21], atol=1e-6)
+
+
+def test_sp_deferred_chunk_wider_than_shard():
+    """sp=4 on seq_len=32 gives 8-slot shards; a 16-token prefill chunk is wider
+    than a shard — the deferred commit must scatter it across multiple shards
+    (regression: the windowed write only handles t <= shard size)."""
+    spec = _tiny_spec()  # seq_len=32 -> sb=8 at sp=4
+    params = init_random_params(spec, FloatType.F32, seed=4)
+    rope = RopeTables.create(spec)
+    tokens = jnp.asarray([[(i % 200) + 1 for i in range(16)]])
+
+    kc, vc = init_kv_cache(spec)
+    want, wkc, wvc = forward(params, spec, rope, tokens, kc, vc, jnp.int32(0))
+    want2, _, _ = forward(params, spec, rope, jnp.asarray([[3]]), wkc, wvc,
+                          jnp.int32(16))
+
+    mesh = make_mesh(sp=4, tp=2)
+    sparams = shard_params(params, mesh, spec)
+    step = make_sharded_forward(spec, mesh, sparams, donate_cache=False,
+                                cache_write="deferred")
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    got, gkc, gvc = step(sparams, rope, tokens, kc, vc, jnp.int32(0))
+    got2, _, _ = step(sparams, rope, jnp.asarray([[3]]), gkc, gvc, jnp.int32(16))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
+                               rtol=1e-3)
     np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), atol=2e-4,
                                rtol=1e-3)
 
