@@ -46,6 +46,8 @@ from distributed_llama_tpu.ops.rope import RopeTables  # noqa: E402
 from distributed_llama_tpu.parallel.mesh import make_mesh  # noqa: E402
 from distributed_llama_tpu.parallel.tp import (  # noqa: E402
     init_sharded_kv_cache, make_sharded_forward, shard_params)
+from distributed_llama_tpu.ops.pallas_prologue import (  # noqa: E402
+    prologue_supported)
 from distributed_llama_tpu.quants import QK, FloatType, QTensor  # noqa: E402
 
 BASELINE_TOK_S = 1000.0 / 101.81  # Llama-2-7B, 1x GCP c3d VM (reference README.md:131)
@@ -228,6 +230,10 @@ def main():
     ap.add_argument("--no-fuse", action="store_true",
                     help="keep wq/wk/wv and w1/w3 as separate kernel launches "
                          "instead of the merged wqkv/w13 groups (A/B lever)")
+    ap.add_argument("--prologue", action="store_true",
+                    help="fused rmsnorm+quantize prologue kernels "
+                         "(ops/pallas_prologue.py) feeding the inline-Xexp "
+                         "matvec variants — opt-in until the hardware A/B lands")
     args = ap.parse_args()
 
     if not os.environ.get("DLT_WARM_RUNNER") and os.environ.get("JAX_PLATFORMS") != "cpu":
@@ -284,7 +290,7 @@ def main():
         is_headline = all(
             getattr(args, k) == ap.get_default(k)
             for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
-                      "window", "cache_write", "no_fuse")
+                      "window", "cache_write", "no_fuse", "prologue")
         ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
         if is_headline and os.path.exists(HANDOFF_LATEST):
             try:
@@ -345,12 +351,16 @@ def main():
     def compile_with_fallback(make_and_warm):
         """Build + compile down a degradation ladder so an unattended driver run
         records a downgraded number (with fallback_reason) instead of crashing.
-        With the defaults (i4p, deferred) the rungs are:
+        Rungs are (layout, cache_write, prologue); with the defaults (i4p,
+        deferred, no prologue):
 
-            (i4p, deferred)
-            -> (i4p, inscan)   # deferred path / fused attention failed to lower
-            -> (i8, deferred)  # the 4-bit kernel failed to lower
-            -> (i8, inscan)    # both failed
+            (i4p, deferred, -)
+            -> (i4p, inscan, -)   # deferred path / fused attention failed to lower
+            -> (i8, deferred, -)  # the 4-bit kernel failed to lower
+            -> (i8, inscan, -)    # both failed
+
+        With --prologue, one extra rung (i4p, deferred, prologue) sits on top:
+        a prologue-kernel lowering failure drops ONLY the prologue first.
 
         Each failed attempt's parameter set must be FULLY dropped before the next so
         peak HBM holds one set. `state.pop("params")` alone is not enough: the caught
@@ -359,22 +369,28 @@ def main():
         and turned round 3's lowering failure into RESOURCE_EXHAUSTED
         (BENCH_r03.json). Capture the message only, clear the traceback, and
         gc.collect() before re-synthesizing."""
-        ladder = [(layout, args.cache_write)]
+        ladder = [(layout, args.cache_write, args.prologue)]
+        if args.prologue:
+            # prologue-kernel failure alone: drop it first, keep everything else
+            ladder.append((layout, args.cache_write, False))
         if args.cache_write != "inscan":
             # deferred/fused-attention failure: keep the better 4-bit layout
-            ladder.append((layout, "inscan"))
+            ladder.append((layout, "inscan", False))
         if layout == "i4p":
             if args.cache_write != "inscan":
                 # q4-kernel failure alone: keep the deferred discipline
-                ladder.append(("i8", args.cache_write))
-            ladder.append(("i8", "inscan"))
+                ladder.append(("i8", args.cache_write, False))
+            ladder.append(("i8", "inscan", False))
         reasons = []
-        for attempt, (lay, cw) in enumerate(ladder):
+        for attempt, (lay, cw, prol) in enumerate(ladder):
             state["cache_write"] = cw
+            state["prologue"] = prol
             try:
                 return make_and_warm(*build(lay))
             except Exception as e:
-                reasons.append(f"{lay}/{cw}: {type(e).__name__}: {e}"[:200])
+                reasons.append(
+                    f"{lay}/{cw}{'/prologue' if prol else ''}: "
+                    f"{type(e).__name__}: {e}"[:200])
                 e.__traceback__ = None
                 del e  # drop the exception (and its frame refs) entirely
                 import gc
@@ -419,7 +435,8 @@ def main():
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
                                         use_pallas=on_tpu, donate_cache=True,
                                         attn_window=pwindow,
-                                        cache_write=state["cache_write"])
+                                        cache_write=state["cache_write"],
+                                        fused_prologue=state["prologue"])
             logits, kc, vc = step(params, rope, toks, kc, vc, jnp.int32(0))  # compile
             np.asarray(logits[0, 0, 0])
             return step, params, kc, vc
@@ -440,6 +457,7 @@ def main():
             "chunk": t_chunk, "weight_gb": round(state["wbytes"] / 1e9, 3),
             "layout": state["layout"], "cache_write": state["cache_write"],
             "ms_per_chunk": round(dt_all / n_disp * 1e3, 2),
+            "prologue": False,  # prologue is decode-only (t == 1)
         }
         if "fallback_reason" in state:
             out["fallback_reason"] = state["fallback_reason"]
@@ -456,7 +474,8 @@ def main():
             loop = make_decode_loop(spec, mesh, params, chunk, mode="greedy",
                                     dtype=dtype, use_pallas=on_tpu,
                                     attn_window=window,
-                                    cache_write=state["cache_write"])
+                                    cache_write=state["cache_write"],
+                                    fused_prologue=state["prologue"])
             toks, _, kc, vc = loop(params, rope, 1, kc, vc, 0, key)  # compile + warm
             np.asarray(toks)
             return loop, params, kc, vc
@@ -476,7 +495,8 @@ def main():
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
                                         use_pallas=on_tpu, donate_cache=True,
                                         attn_window=window,
-                                        cache_write=state["cache_write"])
+                                        cache_write=state["cache_write"],
+                                        fused_prologue=state["prologue"])
             logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(0))  # compile
             np.asarray(logits[0, 0, 0])
             return step, params, kc, vc
@@ -509,6 +529,11 @@ def main():
         "attn_window": window or spec.seq_len,
         "device_loop": args.device_loop,
         "fused": not args.no_fuse,
+        # report the EFFECTIVE prologue state: forward() re-gates it off for
+        # non-pallas runs and unsupported dims, and an A/B record claiming a
+        # lever that never engaged would corrupt the comparison
+        "prologue": bool(state["prologue"] and on_tpu
+                         and prologue_supported(spec.dim)),
     }
     if "fallback_reason" in state:
         out["fallback_reason"] = state["fallback_reason"]

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import gelu_tanh, rmsnorm, silu
-from ..ops.matmul import qmatmul
+from ..ops.matmul import qmatmul, qmatmul_q80
 from ..ops.ring_attention import (commit_kv_rows_sharded, ring_attention,
                                   update_kv_cache_sharded)
 from ..ops.rope import RopeTables, apply_rope
@@ -80,7 +80,7 @@ def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> 
 
 def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos,
                positions, axis_name, sp_axis_name, sp_size, use_pallas, compress,
-               window, deferred_write=False):
+               window, deferred_write=False, prologue=False):
     """Sharded attention sub-block against the FULL stacked caches (L, B, hk, S, hs).
 
     Head counts in bp may be TP-local slices; the cache sequence axis may be sp-sharded
@@ -96,12 +96,28 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
     b, t, _ = x.shape
     hs = spec.head_size
     _, _, hk, s, _ = kc.shape
-    xb = rmsnorm(x, bp["rms_att"], spec.norm_eps)
+    if prologue:
+        # fused rmsnorm+quantize prologue kernel (ops/pallas_prologue.py): the
+        # norm and the Q80 activation quantization every decode matvec needs
+        # collapse into one VPU pass, and the quantized row feeds the inline-Xexp
+        # matvec directly (qmatmul_q80)
+        from ..ops.pallas_prologue import rmsnorm_quantize_q80
+
+        xq, sx = rmsnorm_quantize_q80(x, bp["rms_att"], spec.norm_eps)
+
+        def project(wname):
+            return qmatmul_q80(xq, sx, bp[wname], use_pallas=use_pallas,
+                               out_dtype=x.dtype)
+    else:
+        xb = rmsnorm(x, bp["rms_att"], spec.norm_eps)
+
+        def project(wname):
+            return qmatmul(xb, bp[wname], use_pallas=use_pallas)
     if "wqkv" in bp:
         # merged QKV (models/params.py fuse_matvec_groups): ONE kernel launch for
         # all three projections. Local row counts split proportionally to the
         # global dim : kv : kv ratio (exact — every term divides by tp).
-        qkv = qmatmul(xb, bp["wqkv"], use_pallas=use_pallas)
+        qkv = project("wqkv")
         total = qkv.shape[-1]
         lq = total * spec.dim // (spec.dim + 2 * spec.kv_dim)
         lkv = (total - lq) // 2
@@ -109,9 +125,24 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         k = qkv[..., lq:lq + lkv]
         v = qkv[..., lq + lkv:]
     else:
-        q = qmatmul(xb, bp["wq"], use_pallas=use_pallas)
-        k = qmatmul(xb, bp["wk"], use_pallas=use_pallas)
-        v = qmatmul(xb, bp["wv"], use_pallas=use_pallas)
+        q = project("wq")
+        k = project("wk")
+        v = project("wv")
+
+    def project_out(att):
+        """wo projection + TP merge; under the prologue the attention output is
+        quantized by the fused kernel instead of inside the matvec. The TP-local
+        row width (hq_local*hs) is re-checked — the forward()-level gate only
+        validated spec.dim."""
+        from ..ops.pallas_prologue import prologue_supported, quantize_q80_row
+
+        if prologue and prologue_supported(att.shape[-1]):
+            aq, asx = quantize_q80_row(att)
+            y = qmatmul_q80(aq, asx, bp["wo"], use_pallas=use_pallas,
+                            out_dtype=x.dtype)
+        else:
+            y = qmatmul(att, bp["wo"], use_pallas=use_pallas)
+        return _maybe_psum(y, axis_name, compress)
     hq_local = q.shape[-1] // hs
     hk_local = k.shape[-1] // hs
     q = apply_rope(q.reshape(b, t, hq_local, hs), rope, positions)
@@ -136,8 +167,7 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
             att = ring_attention(q, kl, vl, positions, axis_name=sp_axis_name,
                                  axis_size=sp_size, live_end=start_pos,
                                  chunk=(k_t, v_t, start_pos))
-            attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas),
-                                   axis_name, compress)
+            attn_out = project_out(att)
             return attn_out, (k_t, v_t)  # new rows only; caller commits post-scan
         # in-scan form: layer slice out, sharded update, full-layer write-back
         # (the ring path reads the whole local slice anyway)
@@ -180,8 +210,7 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
                 q.reshape(hk, g, hs).astype(jnp.float32), kc, vc,
                 k_t[0], v_t[0], layer_idx, start_pos, window=win)
             att = out.reshape(1, 1, hq_local * hs).astype(x.dtype)
-            attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas),
-                                   axis_name, compress)
+            attn_out = project_out(att)
             return attn_out, (k_t, v_t)
         kw = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
         vw = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
@@ -200,8 +229,7 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         kfull = jnp.concatenate([kw, k_t], axis=2)  # (B, hk, win+T, hs)
         vfull = jnp.concatenate([vw, v_t], axis=2)
         att = gqa_attention(q, kfull, vfull, positions, key_positions=key_pos)
-        attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas),
-                               axis_name, compress)
+        attn_out = project_out(att)
         return attn_out, (k_t, v_t)  # new rows only; caller commits post-scan
     elif start_pos.ndim == 1:
         # per-row offsets (continuous batching): vmap'd per-row write on the layer
@@ -224,7 +252,7 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         vw = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, win, hs))[0]
         att = gqa_attention(q, kw, vw, positions)
     # col-parallel wo: local heads x local input slice -> partial (B, T, dim); psum merges
-    attn_out = _maybe_psum(qmatmul(att, bp["wo"], use_pallas=use_pallas), axis_name, compress)
+    attn_out = project_out(att)
     return attn_out, (kc, vc)
 
 
@@ -240,6 +268,36 @@ def _dense_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
         h = act(qmatmul(xb, bp["w1"], use_pallas=use_pallas)) * qmatmul(
             xb, bp["w3"], use_pallas=use_pallas)
     return _maybe_psum(qmatmul(h, bp["w2"], use_pallas=use_pallas), axis_name, compress)
+
+
+def _dense_ffn_q80(x, bp, spec: ModelSpec, axis_name, use_pallas, compress):
+    """Dense FFN with the fused rmsnorm+quantize prologue: both activation rows
+    (the normed block input and the gated hidden) are quantized by one kernel
+    each instead of inside the matvecs (ops/pallas_prologue.py). The TP-local
+    hidden width is re-checked before the h-row kernel — the forward()-level
+    gate only validated spec.dim."""
+    from ..ops.pallas_prologue import (prologue_supported, quantize_q80_row,
+                                       rmsnorm_quantize_q80)
+
+    act = _act(spec)
+    xq, sx = rmsnorm_quantize_q80(x, bp["rms_ffn"], spec.norm_eps)
+    if "w13" in bp:
+        y = qmatmul_q80(xq, sx, bp["w13"], use_pallas=use_pallas,
+                        out_dtype=jnp.float32)
+        hl = y.shape[-1] // 2
+        h = act(y[..., :hl]) * y[..., hl:]
+    else:
+        h = act(qmatmul_q80(xq, sx, bp["w1"], use_pallas=use_pallas,
+                            out_dtype=jnp.float32)) * \
+            qmatmul_q80(xq, sx, bp["w3"], use_pallas=use_pallas,
+                        out_dtype=jnp.float32)
+    if prologue_supported(h.shape[-1]):
+        hq, hsx = quantize_q80_row(h)
+        out = qmatmul_q80(hq, hsx, bp["w2"], use_pallas=use_pallas,
+                          out_dtype=x.dtype)
+    else:
+        out = qmatmul(h.astype(x.dtype), bp["w2"], use_pallas=use_pallas)
+    return _maybe_psum(out, axis_name, compress)
 
 
 def _gather_expert(w, idx):
@@ -386,7 +444,7 @@ def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, comp
 
 def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions,
            axis_name, sp_axis_name, sp_size, use_pallas, compress, window,
-           kc_ro=None, vc_ro=None):
+           kc_ro=None, vc_ro=None, prologue=False):
     """One transformer block as a scan step. Two cache disciplines:
 
     - in-scan (kc_ro is None): caches travel in the carry and are updated in place
@@ -404,7 +462,7 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
     attn_out, kvout = _attention(x, bp, layer_idx, spec, rope, kc, vc, start_pos,
                                  positions, axis_name, sp_axis_name, sp_size,
                                  use_pallas, compress, window,
-                                 deferred_write=deferred)
+                                 deferred_write=deferred, prologue=prologue)
     if not deferred:
         kc, vc = kvout
     if spec.arch_type == ArchType.GROK1:
@@ -415,10 +473,13 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
         x = x + rmsnorm(moe_out, bp["rms_ffn2"], spec.norm_eps)
     else:
         x = x + attn_out
-        xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
         if spec.is_moe:
+            xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
             x = x + _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
+        elif prologue:
+            x = x + _dense_ffn_q80(x, bp, spec, axis_name, use_pallas, compress)
         else:
+            xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
             x = x + _dense_ffn(xb, bp, spec, axis_name, use_pallas, compress)
     if deferred:
         return x, kvout  # ys: this layer's (k_t, v_t) new rows
@@ -430,7 +491,8 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             start_pos: jax.Array, *, dtype=jnp.float32, axis_name: str | None = None,
             sp_axis_name: str | None = None, sp_size: int = 1,
             use_pallas: bool = False, compress_collectives: bool = False,
-            attn_window: int | None = None, cache_write: str = "inscan"):
+            attn_window: int | None = None, cache_write: str = "inscan",
+            fused_prologue: bool = False):
     """Run T tokens through the model against the KV cache.
 
     tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar
@@ -477,13 +539,22 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
     assert cache_write in ("inscan", "deferred"), cache_write
     deferred = cache_write == "deferred"
     sp_active = sp_axis_name is not None and sp_size > 1
+    # fused rmsnorm+quantize prologue (ops/pallas_prologue.py): single-row decode
+    # only (the kernels take one activation row), opt-in via fused_prologue
+    if fused_prologue:
+        from ..ops.pallas_prologue import prologue_supported
+
+        fused_prologue = (use_pallas and t == 1 and tokens.shape[0] == 1
+                          and start_pos.ndim == 0
+                          and prologue_supported(spec.dim))
     block_fn = functools.partial(_block, spec=spec, rope=rope, start_pos=start_pos,
                                  positions=positions, axis_name=axis_name,
                                  sp_axis_name=sp_axis_name, sp_size=sp_size,
                                  use_pallas=use_pallas, compress=compress_collectives,
                                  window=attn_window,
                                  kc_ro=k_cache if deferred else None,
-                                 vc_ro=v_cache if deferred else None)
+                                 vc_ro=v_cache if deferred else None,
+                                 prologue=fused_prologue)
     layer_ids = jnp.arange(spec.n_layers, dtype=jnp.int32)
     if deferred:
         x, (k_rows, v_rows) = jax.lax.scan(

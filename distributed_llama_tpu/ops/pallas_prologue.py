@@ -1,0 +1,130 @@
+"""Fused activation-prologue kernels: rmsnorm (+) Q80 quantization in one pass.
+
+Every decode matvec quantizes its activation row to per-32-block int8 (the
+reference's Q80 buffer discipline, src/tasks.cpp:96-135) before the weight kernel
+runs. On the XLA path that costs, per layer, a handful of small fusions (rmsnorm
+reduce, absmax, round/scale) plus — for the non-inline matvec variant — a
+(K, nb) block-diagonal Xexp materialization through HBM. These kernels collapse
+the whole prologue into ONE VPU pass per activation:
+
+    rmsnorm_quantize_q80:  x (1,K) f32/bf16, w (K,)  ->  xq (1,K) i8, sx (1,nb) f32
+    quantize_q80_row:      x (1,K)                   ->  xq (1,K) i8, sx (1,nb) f32
+
+The outputs feed ops.matmul.qmatmul_q80. For i4p weights that routes into the
+inline-Xexp matvec variant (scatter built in kernel scratch,
+pallas_q4._matvec_kernel_inline) so the quantized row is the only activation HBM
+traffic; for i8 weights the block-diagonal Xexp is still materialized in XLA (no
+inline q8 variant yet) — there the prologue saves only the norm/quantize fusions,
+not activation HBM bytes.
+
+Numerics: the rmsnorm reduction runs in f32 with the same mean-square + eps
+formula as ops.kernels.rmsnorm (reference funcs.cpp rms(), eps inside the mean);
+quantization IS pallas_q8._quantize_row (shared helper, pure jnp, usable inside
+kernel bodies). Mosaic portability: all intermediates are f32/i32 except the
+final i8 cast — every op is in the known-good set (perf/PROFILE.md op matrix);
+no f16, no narrow-int arithmetic, no sub-32-bit minor-dim insertion (the one
+f32 minor-dim insert is 32-bit, which Mosaic supports).
+
+Opt-in (Engine fused_prologue / bench --prologue) until a hardware A/B lands —
+the round-4 lesson is not to ship never-executed kernels as defaults.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quants import QK
+
+
+def _quantize_store(xb, xq_ref, sx_ref):
+    """Shared epilogue: per-32-block absmax quantize of xb (1, K) f32 into the
+    int8 row + f32 block-scale outputs. The math is pallas_q8._quantize_row
+    itself (pure jnp, kernel-body safe) — one source of truth for the Q80
+    formula."""
+    from .pallas_q8 import _quantize_row
+
+    k = xb.shape[1]
+    xq, sx = _quantize_row(xb.reshape(k), k // QK)
+    xq_ref[:] = xq.reshape(1, k)
+    sx_ref[:] = sx
+
+
+def _rmsnorm_q80_kernel(x_ref, w_ref, xq_ref, sx_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)  # (1, K)
+    k = x.shape[1]
+    ms = jnp.sum(x * x, axis=1, keepdims=True) / k  # (1, 1), f32 reduction
+    inv = jnp.reciprocal(jnp.sqrt(ms + eps))
+    xb = x * inv * w_ref[:].astype(jnp.float32)
+    _quantize_store(xb, xq_ref, sx_ref)
+
+
+def _quantize_kernel(x_ref, xq_ref, sx_ref):
+    _quantize_store(x_ref[:].astype(jnp.float32), xq_ref, sx_ref)
+
+
+def prologue_supported(k: int) -> bool:
+    """Single-block VMEM kernel: the row (f32) plus outputs must be tiny. K up to
+    64k (256 KB f32) is far under VMEM; require whole 32-blocks."""
+    return k % QK == 0 and k <= (1 << 16)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def _rmsnorm_q80(x, w, *, eps: float, interpret: bool):
+    _, k = x.shape
+    nb = k // QK
+    return pl.pallas_call(
+        functools.partial(_rmsnorm_q80_kernel, eps=eps),
+        in_specs=[
+            pl.BlockSpec((1, k), lambda: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k), lambda: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((1, k), jnp.int8),
+                   jax.ShapeDtypeStruct((1, nb), jnp.float32)],
+        interpret=interpret,
+    )(x, w)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _quantize(x, *, interpret: bool):
+    _, k = x.shape
+    nb = k // QK
+    return pl.pallas_call(
+        _quantize_kernel,
+        in_specs=[pl.BlockSpec((1, k), lambda: (0, 0), memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((1, k), lambda: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((1, k), jnp.int8),
+                   jax.ShapeDtypeStruct((1, nb), jnp.float32)],
+        interpret=interpret,
+    )(x)
+
+
+def rmsnorm_quantize_q80(x: jax.Array, w: jax.Array, eps: float,
+                         *, interpret: bool | None = None):
+    """x (..., K) with leading dims multiplying to 1 -> (xq (1, K) i8,
+    sx (1, nb) f32) of rmsnorm(x, w) quantized per 32-block."""
+    k = x.shape[-1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _rmsnorm_q80(x.reshape(1, k), w.reshape(1, k), eps=float(eps),
+                        interpret=interpret)
+
+
+def quantize_q80_row(x: jax.Array, *, interpret: bool | None = None):
+    """x (..., K) with leading dims multiplying to 1 -> (xq (1, K) i8,
+    sx (1, nb) f32)."""
+    k = x.shape[-1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _quantize(x.reshape(1, k), interpret=interpret)

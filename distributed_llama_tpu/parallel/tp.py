@@ -114,7 +114,8 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
                          compress_collectives: bool = False, donate_cache: bool = True,
                          attn_window: int | None = None,
                          cache_write: str = "inscan",
-                         moe_sharding: str = "slice"):
+                         moe_sharding: str = "slice",
+                         fused_prologue: bool = False):
     """Build the jitted SPMD forward step over the mesh's tp axis.
 
     Returns fn(params, rope, tokens, k_cache, v_cache, start_pos) ->
@@ -148,7 +149,8 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
-                            attn_window=attn_window, cache_write=cache_write)
+                            attn_window=attn_window, cache_write=cache_write,
+                            fused_prologue=fused_prologue)
     rope_type = spec.rope_type
 
     def step(p, rope_cos, rope_sin, tokens, kc, vc, start_pos):

@@ -85,7 +85,7 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
                      dtype=None, use_pallas: bool = False,
                      compress_collectives: bool = False, donate_cache: bool = True,
                      attn_window: int | None = None, cache_write: str = "inscan",
-                     moe_sharding: str = "slice"):
+                     moe_sharding: str = "slice", fused_prologue: bool = False):
     """Build fn(params, rope, token, kc, vc, start_pos, key, temperature, topp) ->
     (tokens (n_steps,), last_logits (vocab,), kc, vc).
 
@@ -109,7 +109,8 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
-                            attn_window=attn_window, cache_write=cache_write)
+                            attn_window=attn_window, cache_write=cache_write,
+                            fused_prologue=fused_prologue)
 
     def loop(p, rope_cos, rope_sin, token, kc, vc, start_pos, key, temperature, topp):
         rope = RopeTables(rope_cos, rope_sin, rope_type)

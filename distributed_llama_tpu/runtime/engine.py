@@ -85,7 +85,7 @@ class Engine:
                  use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1,
                  pod: bool = False, cache_write: str | None = None,
-                 moe_sharding: str = "slice"):
+                 moe_sharding: str = "slice", fused_prologue: bool | None = None):
         self.spec = spec
         self.tokenizer = tokenizer
         on_tpu = jax.default_backend() == "tpu"
@@ -124,6 +124,14 @@ class Engine:
         # is a masked window write — commit_kv_rows_sharded). None = auto
         # (deferred).
         self.cache_write = cache_write or "deferred"
+        # fused rmsnorm+quantize prologue kernels (ops/pallas_prologue.py):
+        # opt-in (flag or DLT_PROLOGUE=1) until the hardware A/B lands — the
+        # round-4 lesson is not to default to never-executed kernels
+        if fused_prologue is None:
+            import os
+
+            fused_prologue = bool(os.environ.get("DLT_PROLOGUE"))
+        self.fused_prologue = fused_prologue
         # MoE expert placement: "slice" TP-slices every expert's hidden axis (the
         # reference's scheme); "expert" shards WHOLE experts over tp — the capacity
         # axis for Grok-1-314B-class expert weights (parallel/sharding.py)
@@ -172,7 +180,8 @@ class Engine:
                 self.spec, self.mesh, self.params, dtype=self.dtype,
                 use_pallas=self.use_pallas, compress_collectives=self.compress,
                 donate_cache=True, attn_window=window,
-                cache_write=self.cache_write, moe_sharding=self.moe_sharding)
+                cache_write=self.cache_write, moe_sharding=self.moe_sharding,
+                fused_prologue=self.fused_prologue)
         return self._steps[window]
 
     @property
@@ -363,7 +372,8 @@ class Engine:
                 use_pallas=self.use_pallas,
                 compress_collectives=self.compress, donate_cache=True,
                 attn_window=window, cache_write=self.cache_write,
-                moe_sharding=self.moe_sharding)
+                moe_sharding=self.moe_sharding,
+                fused_prologue=self.fused_prologue)
         return self._decode_loops[chunk, mode, window]
 
     def _loop_traffic(self, chunk: int, mode: str, loop):

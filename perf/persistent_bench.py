@@ -47,6 +47,7 @@ HEADLINE = ["--steps", "32"]
 CONFIGS = [
     HEADLINE,
     ["--steps", "32", "--no-fuse"],
+    ["--steps", "32", "--prologue"],
     ["--steps", "32", "--cache-write", "inscan"],
     ["--steps", "32", "--layout", "i8"],
     ["--steps", "32", "--device-loop", "8"],

@@ -1,0 +1,128 @@
+"""Fused rmsnorm+quantize prologue kernels (ops/pallas_prologue.py), interpret mode.
+
+The prologue collapses the XLA-side rmsnorm + Q80 activation quantization into one
+kernel per activation row and feeds the inline-Xexp matvec variants. Its numerics
+must match the existing XLA prologue (pallas_q8._quantize_row) bit-for-bit on the
+quantize step and the kernel-path forward to float tolerance end-to-end."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.forward import forward, init_kv_cache
+from distributed_llama_tpu.models.params import (init_random_params,
+                                                 prepare_for_pallas)
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.ops.kernels import rmsnorm
+from distributed_llama_tpu.ops.pallas_prologue import (quantize_q80_row,
+                                                       rmsnorm_quantize_q80)
+from distributed_llama_tpu.ops.pallas_q8 import _quantize_row
+from distributed_llama_tpu.ops.rope import RopeTables
+from distributed_llama_tpu.quants import QK, FloatType
+
+
+def test_quantize_kernel_matches_xla_quantize():
+    rng = np.random.RandomState(0)
+    k = 256
+    x = jnp.asarray(rng.randn(k).astype(np.float32)) * 3.0
+    xq_want, sx_want = _quantize_row(x, k // QK)
+    xq, sx = quantize_q80_row(x, interpret=True)
+    np.testing.assert_array_equal(np.asarray(xq).ravel(), np.asarray(xq_want))
+    np.testing.assert_allclose(np.asarray(sx), np.asarray(sx_want), rtol=1e-7)
+
+
+def test_quantize_kernel_zero_block():
+    """An all-zero block must produce scale 0 and zeros, not NaN (the
+    zero-guarded inverse)."""
+    x = jnp.zeros((64,), jnp.float32).at[40].set(5.0)
+    xq, sx = quantize_q80_row(x, interpret=True)
+    assert np.asarray(sx)[0, 0] == 0.0
+    assert not np.isnan(np.asarray(sx)).any()
+    np.testing.assert_array_equal(np.asarray(xq)[0, :32], 0)
+
+
+def test_rmsnorm_quantize_matches_separate_ops():
+    rng = np.random.RandomState(1)
+    k = 512
+    x = jnp.asarray(rng.randn(1, 1, k).astype(np.float32))
+    w = jnp.asarray(1.0 + 0.1 * rng.randn(k).astype(np.float32))
+    eps = 1e-5
+    xb = rmsnorm(x, w, eps).reshape(k)
+    xq_want, sx_want = _quantize_row(xb, k // QK)
+    xq, sx = rmsnorm_quantize_q80(x, w, eps, interpret=True)
+    # the kernel normalizes in f32 exactly like ops.kernels.rmsnorm on f32 input
+    np.testing.assert_array_equal(np.asarray(xq).ravel(), np.asarray(xq_want))
+    np.testing.assert_allclose(np.asarray(sx), np.asarray(sx_want), rtol=1e-6)
+
+
+def _spec():
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=128, seq_len=16,
+                     rope_type=RopeType.LLAMA).resolved()
+
+
+@pytest.mark.parametrize("fuse", [True, False])
+def test_forward_prologue_matches_kernel_path(fuse):
+    """Decode through the prologue kernels == the plain kernel path (same Q80
+    quantization points, so agreement is float-tolerance, not Q80-scale)."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=7)
+    rope = RopeTables.create(spec)
+    pp = prepare_for_pallas(params, spec=spec, fuse=fuse)
+
+    tok = jnp.asarray([[5]])
+    kc, vc = init_kv_cache(spec)
+    want, _, _ = forward(pp, spec, rope, tok, kc, vc, jnp.int32(0),
+                         use_pallas=True)
+    kc, vc = init_kv_cache(spec)
+    got, kcp, vcp = forward(pp, spec, rope, tok, kc, vc, jnp.int32(0),
+                            use_pallas=True, fused_prologue=True)
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 2e-5, rel
+
+
+def test_prologue_sharded_decode_matches():
+    """tp=2 shard_map decode with the prologue == planar sharded step (Q80
+    activation-quantization error scale)."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
+                                                   make_sharded_forward,
+                                                   shard_params)
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    mesh = make_mesh(tp=2)
+    tok = jnp.asarray([[5]])
+    rope = RopeTables.create(spec)
+
+    base = shard_params(params, mesh, spec)
+    step = make_sharded_forward(spec, mesh, base, donate_cache=False)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    want, _, _ = step(base, rope, tok, kc, vc, jnp.int32(0))
+
+    pp = shard_params(prepare_for_pallas(params, tp=2, spec=spec), mesh, spec)
+    stepp = make_sharded_forward(spec, mesh, pp, use_pallas=True,
+                                 donate_cache=False, fused_prologue=True)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    got, _, _ = stepp(pp, rope, tok, kc, vc, jnp.int32(0))
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 0.03, rel
+
+
+def test_prologue_engine_generation_matches():
+    """End-to-end greedy generation with the prologue engine == without (the
+    prologue changes where quantization happens, not its values — greedy tokens
+    must be identical)."""
+    from distributed_llama_tpu.runtime.engine import Engine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=13)
+    base = Engine(spec, params, tp=1, use_pallas=True)
+    want, _ = base.generate([1, 7, 3], 8, Sampler(spec.vocab_size, temperature=0.0))
+
+    eng = Engine(spec, params, tp=1, use_pallas=True, fused_prologue=True)
+    got, _ = eng.generate([1, 7, 3], 8, Sampler(spec.vocab_size, temperature=0.0))
+    assert got == want
