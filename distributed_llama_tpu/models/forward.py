@@ -158,15 +158,26 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
             # chunk's K/V as a register block, and the new rows ride out as scan
             # ys for forward() to commit with ONE masked window write per cache
             # (ops/ring_attention.py commit_kv_rows_sharded).
+            #
+            # The deferred sp cache is STRIPED (member m's slot j = position
+            # j*sp + m): the live context occupies the same slot prefix on every
+            # member, so a static window bucket bounds each rotation to
+            # ceil(window/sp) columns — ICI and HBM per step track the LIVE
+            # context, not the allocated seq_len (the sp analog of attn_window;
+            # impossible under contiguous sharding, where the live prefix
+            # concentrates on low-index members).
             k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)  # (B, hk, T, hs)
             v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
             kl = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0),
                                        (1, b, hk, s, hs))[0]
             vl = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0),
                                        (1, b, hk, s, hs))[0]
+            wl = (None if window is None
+                  else min((window + sp_size - 1) // sp_size, s))
             att = ring_attention(q, kl, vl, positions, axis_name=sp_axis_name,
                                  axis_size=sp_size, live_end=start_pos,
-                                 chunk=(k_t, v_t, start_pos))
+                                 chunk=(k_t, v_t, start_pos), striped=True,
+                                 window_slots=wl)
             attn_out = project_out(att)
             return attn_out, (k_t, v_t)  # new rows only; caller commits post-scan
         # in-scan form: layer slice out, sharded update, full-layer write-back
@@ -256,42 +267,39 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
     return attn_out, (kc, vc)
 
 
-def _dense_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
+def _dense_ffn(x, bp, spec: ModelSpec, axis_name, use_pallas, compress,
+               prologue=False):
+    """Dense FFN on the PRE-norm block input x (the rms_ffn norm is applied
+    here so the prologue can fuse it with the activation quantize). One body
+    for both modes — only the projection primitive differs: under the prologue
+    each activation row is quantized by a fused kernel (ops/pallas_prologue.py)
+    and qmatmul_q80 consumes the pre-quantized row; otherwise the matvecs
+    quantize internally. TP-local widths are re-checked before each prologue
+    kernel — the forward()-level gate only validated spec.dim."""
     act = _act(spec)
+    if prologue:
+        from ..ops.pallas_prologue import (prologue_supported, quantize_q80_row,
+                                           rmsnorm_quantize_q80)
+
+        xq, sx = rmsnorm_quantize_q80(x, bp["rms_ffn"], spec.norm_eps)
+
+        def project(wname):
+            return qmatmul_q80(xq, sx, bp[wname], use_pallas=use_pallas,
+                               out_dtype=jnp.float32)
+    else:
+        xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
+
+        def project(wname):
+            return qmatmul(xb, bp[wname], use_pallas=use_pallas)
     if "w13" in bp:
         # merged gate+up (fuse_matvec_groups): one launch, halves split evenly
         # ([w1|w3] per TP group — both are (hidden, dim))
-        y = qmatmul(xb, bp["w13"], use_pallas=use_pallas)
+        y = project("w13")
         hl = y.shape[-1] // 2
         h = act(y[..., :hl]) * y[..., hl:]
     else:
-        h = act(qmatmul(xb, bp["w1"], use_pallas=use_pallas)) * qmatmul(
-            xb, bp["w3"], use_pallas=use_pallas)
-    return _maybe_psum(qmatmul(h, bp["w2"], use_pallas=use_pallas), axis_name, compress)
-
-
-def _dense_ffn_q80(x, bp, spec: ModelSpec, axis_name, use_pallas, compress):
-    """Dense FFN with the fused rmsnorm+quantize prologue: both activation rows
-    (the normed block input and the gated hidden) are quantized by one kernel
-    each instead of inside the matvecs (ops/pallas_prologue.py). The TP-local
-    hidden width is re-checked before the h-row kernel — the forward()-level
-    gate only validated spec.dim."""
-    from ..ops.pallas_prologue import (prologue_supported, quantize_q80_row,
-                                       rmsnorm_quantize_q80)
-
-    act = _act(spec)
-    xq, sx = rmsnorm_quantize_q80(x, bp["rms_ffn"], spec.norm_eps)
-    if "w13" in bp:
-        y = qmatmul_q80(xq, sx, bp["w13"], use_pallas=use_pallas,
-                        out_dtype=jnp.float32)
-        hl = y.shape[-1] // 2
-        h = act(y[..., :hl]) * y[..., hl:]
-    else:
-        h = act(qmatmul_q80(xq, sx, bp["w1"], use_pallas=use_pallas,
-                            out_dtype=jnp.float32)) * \
-            qmatmul_q80(xq, sx, bp["w3"], use_pallas=use_pallas,
-                        out_dtype=jnp.float32)
-    if prologue_supported(h.shape[-1]):
+        h = act(project("w1")) * project("w3")
+    if prologue and prologue_supported(h.shape[-1]):
         hq, hsx = quantize_q80_row(h)
         out = qmatmul_q80(hq, hsx, bp["w2"], use_pallas=use_pallas,
                           out_dtype=x.dtype)
@@ -476,11 +484,9 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
         if spec.is_moe:
             xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
             x = x + _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
-        elif prologue:
-            x = x + _dense_ffn_q80(x, bp, spec, axis_name, use_pallas, compress)
         else:
-            xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
-            x = x + _dense_ffn(xb, bp, spec, axis_name, use_pallas, compress)
+            x = x + _dense_ffn(x, bp, spec, axis_name, use_pallas, compress,
+                               prologue=prologue)
     if deferred:
         return x, kvout  # ys: this layer's (k_t, v_t) new rows
     return (x, kc, vc), None
@@ -562,10 +568,11 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
         # commit all layers' new rows in one write per cache: (L, B, hk, T, hs)
         # lands at [.., .., .., start_pos : start_pos+T, ..]
         if sp_active:
-            # sequence-sharded caches: masked window write into the owning shard
+            # sequence-sharded caches: masked window write into the owning
+            # shards, striped layout (see the _attention sp-deferred branch)
             k_cache, v_cache = commit_kv_rows_sharded(
                 k_cache, v_cache, k_rows, v_rows, start_pos,
-                axis_name=sp_axis_name)
+                axis_name=sp_axis_name, striped=True, axis_size=sp_size)
         elif start_pos.ndim == 0:
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache, k_rows, (0, 0, 0, start_pos, 0))

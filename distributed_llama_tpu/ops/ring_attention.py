@@ -24,14 +24,17 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _block_attend(qg, k_blk, v_blk, positions, global_start, live_end=None):
+def _block_attend(qg, k_blk, v_blk, positions, col_offset, col_stride=1,
+                  live_end=None):
     """Masked scores + unnormalized accumulation for one KV block.
 
     qg: (B, hk, g, T, hs) f32; k_blk/v_blk: (B, hk, Sb, hs); positions: (T,) absolute
-    query positions; global_start: absolute position of the block's first column.
-    live_end, if given, additionally masks columns at positions >= live_end —
-    the deferred-write discipline attends cache blocks only over COMMITTED rows
-    (the current chunk arrives as its own register block instead).
+    query positions. Block column j sits at absolute position
+    col_offset + col_stride*j — contiguous shards use (owner*Sb, 1), the striped
+    layout uses (owner, sp). live_end, if given, additionally masks columns at
+    positions >= live_end — the deferred-write discipline attends cache blocks
+    only over COMMITTED rows (the current chunk arrives as its own register
+    block instead).
     Returns (m (…, T), l (…, T), acc (…, T, hs)) partial softmax stats.
     """
     sb = k_blk.shape[2]
@@ -39,7 +42,7 @@ def _block_attend(qg, k_blk, v_blk, positions, global_start, live_end=None):
     scale = 1.0 / math.sqrt(hs)
     scores = jnp.einsum("bkgtd,bksd->bkgts", qg,
                         k_blk.astype(jnp.float32)) * scale  # (B, hk, g, T, Sb)
-    col_pos = global_start + jnp.arange(sb)  # absolute positions of block columns
+    col_pos = col_offset + col_stride * jnp.arange(sb)  # absolute column positions
     valid = col_pos[None, :] <= positions[:, None]  # (T, Sb) causal
     if live_end is not None:
         valid = valid & (col_pos[None, :] < live_end)
@@ -67,12 +70,24 @@ def ring_attention(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
                    positions: jax.Array, *, axis_name: str, axis_size: int,
                    live_end: jax.Array | None = None,
                    chunk: tuple[jax.Array, jax.Array, jax.Array] | None = None,
-                   ) -> jax.Array:
+                   striped: bool = False,
+                   window_slots: int | None = None) -> jax.Array:
     """Causal GQA attention of T query tokens against a sequence-sharded cache.
 
-    q: (B, T, hq, hs) replicated over sp; k_shard/v_shard: (B, hk, S/sp, hs), the local
-    sequence shard (device i holds absolute positions [i*Sb, (i+1)*Sb)). Returns
-    (B, T, hq*hs), replicated over sp.
+    q: (B, T, hq, hs) replicated over sp; k_shard/v_shard: (B, hk, S/sp, hs), the
+    local sequence shard. Two layouts:
+
+    - contiguous (striped=False): device i holds absolute positions
+      [i*Sb, (i+1)*Sb). The live context [0, pos) is a prefix that concentrates
+      on low-index devices, so every rotation must move the FULL shard.
+    - striped (striped=True): device i's local slot j holds absolute position
+      j*axis_size + i. The live context occupies the first ceil(pos/sp) slots of
+      EVERY shard, so with a static window bucket W covering pos, only
+      window_slots = ceil(W/sp) slots participate — each ring rotation moves
+      W/sp columns instead of S/sp, bounding both ICI and HBM per step by the
+      LIVE context (the sp analog of the dense path's attn_window).
+
+    Returns (B, T, hq*hs), replicated over sp.
 
     Deferred-write mode (models/forward.py cache_write="deferred"): the cache holds
     only COMMITTED rows (positions < live_end == start_pos); the current chunk's
@@ -83,6 +98,11 @@ def ring_attention(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
     b, t, hq, hs = q.shape
     _, hk, sb, _ = k_shard.shape
     g = hq // hk
+    if window_slots is not None and window_slots < sb:
+        assert striped, "window_slots only bounds the striped layout"
+        k_shard = k_shard[:, :, :window_slots]
+        v_shard = v_shard[:, :, :window_slots]
+        sb = window_slots
     # (B, hk, g, T, hs) — block-attend subscripts are head-major
     qg = jnp.moveaxis(q.reshape(b, t, hk, g, hs), 1, 3).astype(jnp.float32)
 
@@ -95,7 +115,8 @@ def ring_attention(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
     k_blk, v_blk = k_shard, v_shard
     for r in range(axis_size):
         owner = (idx + r) % axis_size  # whose shard I currently hold
-        mb, lb, ab = _block_attend(qg, k_blk, v_blk, positions, owner * sb,
+        offset, stride = (owner, axis_size) if striped else (owner * sb, 1)
+        mb, lb, ab = _block_attend(qg, k_blk, v_blk, positions, offset, stride,
                                    live_end=live_end)
         m, l, acc = _combine(m, l, acc, mb, lb, ab)
         if r + 1 < axis_size:
@@ -112,21 +133,47 @@ def ring_attention(q: jax.Array, k_shard: jax.Array, v_shard: jax.Array,
 
 def commit_kv_rows_sharded(k_cache: jax.Array, v_cache: jax.Array,
                            k_rows: jax.Array, v_rows: jax.Array,
-                           start_pos: jax.Array, *, axis_name: str
+                           start_pos: jax.Array, *, axis_name: str,
+                           striped: bool = False, axis_size: int | None = None
                            ) -> tuple[jax.Array, jax.Array]:
     """Deferred-write commit for sequence-sharded caches: write ALL layers' new
     rows in one tiny masked window write per cache.
 
     caches: (L, B, hk, Sb, hs) local shards; rows: (L, B, hk, T, hs) (every sp
     member computed identical rows — activations are sp-replicated). The write
-    window is T wide at the chunk's local offset, clipped into the shard, with a
-    per-slot hit mask so a chunk straddling a shard boundary writes its prefix
-    on one member and its suffix on the next. Total write traffic is O(L·T)
-    rows — the sp counterpart of forward()'s top-level dynamic_update_slice,
-    replacing the full-local-cache carry the in-scan discipline pays."""
+    window is clipped into the shard with a per-slot hit mask so a chunk
+    straddling shard boundaries writes each member exactly its own positions.
+    Total write traffic is O(L·T) rows — the sp counterpart of forward()'s
+    top-level dynamic_update_slice, replacing the full-local-cache carry the
+    in-scan discipline pays.
+
+    striped=True uses the interleaved layout (member m's local slot j holds
+    absolute position j*sp + m — see ring_attention): member m takes the chunk
+    positions with p % sp == m, landing in a ceil(T/sp)(+1) slot window."""
     t = k_rows.shape[3]
     sb = k_cache.shape[3]
     idx = jax.lax.axis_index(axis_name)
+
+    if striped:
+        sp = axis_size
+        assert sp is not None, "striped commit needs the static axis_size"
+        wl = min((t - 1) // sp + 2, sb)  # slot-window width (static)
+        j0 = jnp.clip(start_pos // sp, 0, sb - wl)
+        slots = j0 + jnp.arange(wl)
+        src = slots * sp + idx - start_pos  # which chunk token lands in each slot
+        hit = (src >= 0) & (src < t)
+        src_c = jnp.clip(src, 0, t - 1)
+
+        def write_striped(cache, rows):
+            rows = rows.astype(cache.dtype)
+            cur = jax.lax.dynamic_slice(
+                cache, (0, 0, 0, j0, 0), (*cache.shape[:3], wl, cache.shape[4]))
+            gathered = jnp.take(rows, src_c, axis=3)
+            val = jnp.where(hit[None, None, None, :, None], gathered, cur)
+            return jax.lax.dynamic_update_slice(cache, val, (0, 0, 0, j0, 0))
+
+        return write_striped(k_cache, k_rows), write_striped(v_cache, v_rows)
+
     local = start_pos - idx * sb  # chunk start in MY shard coordinates (may be <0)
 
     if t > sb:

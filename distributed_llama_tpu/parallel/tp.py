@@ -132,8 +132,10 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
     dp = mesh.shape.get(AXIS_DP, 1)
     check_divisibility(spec, tp, sp, moe_sharding=moe_sharding)
     dtype = dtype or jnp.float32
-    if sp > 1:
-        attn_window = None  # ring attention always walks the full sharded cache
+    if sp > 1 and cache_write != "deferred":
+        # the in-scan (contiguous) ring walks the full sharded cache; the
+        # deferred ring is STRIPED and honors the window (models/forward.py)
+        attn_window = None
 
     param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
     kv_spec = kv_cache_pspec_for_mesh(mesh)

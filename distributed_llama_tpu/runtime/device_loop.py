@@ -99,8 +99,10 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     assert mode in ("greedy", "sample"), mode
     dtype = dtype or jnp.float32
     sp = mesh.shape.get(AXIS_SP, 1)
-    if sp > 1:
-        attn_window = None  # ring attention always walks the full sharded cache
+    if sp > 1 and cache_write != "deferred":
+        # the in-scan (contiguous) ring walks the full sharded cache; the
+        # deferred ring is STRIPED and honors the window (models/forward.py)
+        attn_window = None
     param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
     kv_spec = kv_cache_pspec_for_mesh(mesh)
     rope_type = spec.rope_type

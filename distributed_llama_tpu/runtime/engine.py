@@ -130,7 +130,9 @@ class Engine:
         if fused_prologue is None:
             import os
 
-            fused_prologue = bool(os.environ.get("DLT_PROLOGUE"))
+            # parse, don't bool(): DLT_PROLOGUE=0 must mean OFF (A/B control arm)
+            fused_prologue = os.environ.get("DLT_PROLOGUE", "").lower() in (
+                "1", "true", "yes")
         self.fused_prologue = fused_prologue
         # MoE expert placement: "slice" TP-slices every expert's hidden axis (the
         # reference's scheme); "expert" shards WHOLE experts over tp — the capacity
@@ -167,8 +169,10 @@ class Engine:
     def _window_for(self, pos_end: int) -> int | None:
         """Smallest window bucket covering cache positions [0, pos_end)."""
         s = self.spec.seq_len
-        if self.sp > 1 or s <= self._WINDOW_MIN:
-            return None  # ring path reads the full sharded cache; tiny contexts too
+        if self.sp > 1 and self.cache_write != "deferred":
+            return None  # contiguous (inscan) ring walks the full sharded cache
+        if s <= self._WINDOW_MIN:
+            return None  # tiny contexts: no bucketing
         w = self._WINDOW_MIN
         while w < pos_end:
             w *= 2

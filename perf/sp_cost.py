@@ -39,11 +39,12 @@ from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
 from distributed_llama_tpu.quants import FloatType
 
 
-def run_config(spec, params, rope, *, sp, tp, cache_write, steps, pos0):
+def run_config(spec, params, rope, *, sp, tp, cache_write, steps, pos0,
+               window=None):
     mesh = make_mesh(sp=sp, tp=tp)
     sparams = shard_params(params, mesh, spec)
     step = make_sharded_forward(spec, mesh, sparams, donate_cache=True,
-                                cache_write=cache_write)
+                                cache_write=cache_write, attn_window=window)
     kc, vc = init_sharded_kv_cache(spec, mesh)
     tok = jnp.asarray([[1]], jnp.int32)
     # warm/compile + advance to pos0 so the ring walks a realistic live region
@@ -78,7 +79,10 @@ def main():
                      seq_len=args.seq, rope_type=RopeType.LLAMA).resolved()
     params = init_random_params(spec, FloatType.F32, seed=0)
     rope = RopeTables.create(spec)
-    pos0 = args.seq // 2  # mid-context: half the ring's columns are live
+    # quarter-context: live region fits the seq//2 window bucket of the windowed
+    # configs (contract: start_pos + steps <= window) while the full-cache
+    # configs still walk 4x the live columns
+    pos0 = args.seq // 4
 
     configs = [
         dict(sp=1, tp=2, cache_write="deferred"),
@@ -87,6 +91,10 @@ def main():
         dict(sp=2, tp=2, cache_write="inscan"),
         dict(sp=4, tp=2, cache_write="deferred"),
         dict(sp=4, tp=2, cache_write="inscan"),
+        # windowed striped ring (deferred-only capability): rotations move
+        # ceil(window/sp) slots instead of the full shard
+        dict(sp=2, tp=2, cache_write="deferred", window=args.seq // 2),
+        dict(sp=4, tp=2, cache_write="deferred", window=args.seq // 2),
     ]
     for cfg in configs:
         ms = run_config(spec, params, rope, steps=args.steps, pos0=pos0, **cfg)

@@ -116,10 +116,23 @@ def test_forward_sp_tp_equals_unsharded(cache_write):
                                rtol=1e-3)
 
 
+def _destripe(cache: np.ndarray, sp: int) -> np.ndarray:
+    """Undo the striped sp layout: member m's local slot j holds position
+    j*sp + m (ops/ring_attention.py); the GLOBAL array concatenates members'
+    shards, so array index m*Sb + j -> position j*sp + m."""
+    L, B, hk, S, hs = cache.shape
+    sb = S // sp
+    out = np.zeros_like(cache)
+    for m in range(sp):
+        for j in range(sb):
+            out[:, :, :, j * sp + m] = cache[:, :, :, m * sb + j]
+    return out
+
+
 def test_sp_deferred_cache_state_matches_inscan():
     """After prefill + a boundary-straddling chunk + a decode step, the deferred
-    discipline must leave the sequence-sharded caches byte-identical to inscan
-    (same committed rows, same shard placement)."""
+    (striped) cache must hold the same committed rows as inscan once the stripe
+    permutation is undone."""
     spec = _tiny_spec()  # seq_len=32, sp=2 -> shard size 16
     params = init_random_params(spec, FloatType.F32, seed=9)
     rope = RopeTables.create(spec)
@@ -140,10 +153,12 @@ def test_sp_deferred_cache_state_matches_inscan():
         _, kc, vc = step(sparams, rope, jnp.asarray([[3]]), kc, vc, jnp.int32(20))
         caches[cw] = (np.asarray(kc), np.asarray(vc))
 
+    kd = _destripe(caches["deferred"][0], sp=2)
+    vd = _destripe(caches["deferred"][1], sp=2)
     # committed region [0, 21) must agree exactly; beyond it is unwritten scratch
-    np.testing.assert_allclose(caches["deferred"][0][:, :, :, :21],
+    np.testing.assert_allclose(kd[:, :, :, :21],
                                caches["inscan"][0][:, :, :, :21], atol=1e-6)
-    np.testing.assert_allclose(caches["deferred"][1][:, :, :, :21],
+    np.testing.assert_allclose(vd[:, :, :, :21],
                                caches["inscan"][1][:, :, :, :21], atol=1e-6)
 
 
@@ -168,6 +183,35 @@ def test_sp_deferred_chunk_wider_than_shard():
     kc, vc = init_sharded_kv_cache(spec, mesh)
     got, gkc, gvc = step(sparams, rope, tokens, kc, vc, jnp.int32(0))
     got2, _, _ = step(sparams, rope, jnp.asarray([[3]]), gkc, gvc, jnp.int32(16))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), atol=2e-4,
+                               rtol=1e-3)
+
+
+def test_sp_deferred_windowed_ring_matches_full():
+    """Striped windowed ring: with attn_window=32 on a seq_len=64 cache, only
+    ceil(32/sp)=16 slots per member rotate, and results must equal the
+    unsharded forward while every live position is inside the window."""
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=4, vocab_size=256, seq_len=64,
+                     rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=6)
+    rope = RopeTables.create(spec)
+    tokens = jnp.asarray([[1, 7, 23, 5, 2, 9, 11, 4]])
+
+    kc, vc = init_kv_cache(spec)
+    want, wkc, wvc = forward(params, spec, rope, tokens, kc, vc, jnp.int32(0))
+    want2, _, _ = forward(params, spec, rope, jnp.asarray([[3]]), wkc, wvc,
+                          jnp.int32(8))
+
+    mesh = make_mesh(sp=2, tp=2)
+    sparams = shard_params(params, mesh, spec)
+    step = make_sharded_forward(spec, mesh, sparams, donate_cache=False,
+                                cache_write="deferred", attn_window=32)
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    got, gkc, gvc = step(sparams, rope, tokens, kc, vc, jnp.int32(0))
+    got2, _, _ = step(sparams, rope, jnp.asarray([[3]]), gkc, gvc, jnp.int32(8))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4,
                                rtol=1e-3)
     np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), atol=2e-4,
