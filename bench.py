@@ -132,9 +132,10 @@ def synth_params(spec: ModelSpec, layout: str, fuse: bool = True, tp: int = 1):
                 continue
             if fused_name == "wqkv" and effective_kv_heads(spec, tp) != spec.n_kv_heads:
                 continue
-            rows = sum(shapes[n][0][0] for n in members)
-            in_dim = shapes[members[0]][0][1]
-            shapes[fused_name] = ((rows, in_dim), True)
+            lead = shapes[members[0]][0][:-2]  # MoE stacks carry an E axis
+            rows = sum(shapes[n][0][-2] for n in members)
+            in_dim = shapes[members[0]][0][-1]
+            shapes[fused_name] = (((*lead, rows, in_dim)), True)
             for n in members:
                 del shapes[n]
     blocks = {}
@@ -143,6 +144,11 @@ def synth_params(spec: ModelSpec, layout: str, fuse: bool = True, tp: int = 1):
         full = (spec.n_layers, *shape)
         if quantized:
             blocks[name] = synth_q40(sub, full, layout)
+            if name in _FUSE_GROUPS:
+                import dataclasses
+
+                # stamp the interleave provenance shard_params validates
+                blocks[name] = dataclasses.replace(blocks[name], row_groups=tp)
         else:
             blocks[name] = jnp.ones(full, jnp.float32)
     key, k1, k2 = jax.random.split(key, 3)

@@ -55,7 +55,8 @@ def _localize_qtensors(params):
 
     def fix(t):
         if isinstance(t, QTensor) and t.layout == "i4p" and t.groups != 1:
-            return QTensor(t.ftype, t.data, t.scales, layout="i4p", groups=1)
+            return QTensor(t.ftype, t.data, t.scales, layout="i4p", groups=1,
+                           row_groups=t.row_groups)
         return t
 
     return jax.tree_util.tree_map(fix, params,
@@ -292,11 +293,8 @@ def _dense_ffn(x, bp, spec: ModelSpec, axis_name, use_pallas, compress,
         def project(wname):
             return qmatmul(xb, bp[wname], use_pallas=use_pallas)
     if "w13" in bp:
-        # merged gate+up (fuse_matvec_groups): one launch, halves split evenly
-        # ([w1|w3] per TP group — both are (hidden, dim))
-        y = project("w13")
-        hl = y.shape[-1] // 2
-        h = act(y[..., :hl]) * y[..., hl:]
+        # merged gate+up (fuse_matvec_groups): one launch, [w1|w3] per TP group
+        h = _gated_split(project("w13"), act, gate_first=True)
     else:
         h = act(project("w1")) * project("w3")
     if prologue and prologue_supported(h.shape[-1]):
@@ -306,6 +304,42 @@ def _dense_ffn(x, bp, spec: ModelSpec, axis_name, use_pallas, compress,
     else:
         out = qmatmul(h.astype(x.dtype), bp["w2"], use_pallas=use_pallas)
     return _maybe_psum(out, axis_name, compress)
+
+
+def _gated_split(y, act, gate_first: bool):
+    """Gated-FFN combine from a merged projection output split in halves per TP
+    group: w13 is [gate|up] (act(first)*second), moe_gu is [up|gate]
+    (first*act(second)) — member order set by _FUSE_GROUPS."""
+    hl = y.shape[-1] // 2
+    a, b = y[..., :hl], y[..., hl:]
+    return act(a) * b if gate_first else a * act(b)
+
+
+def _make_expert_step(xb, act, use_pallas, merged):
+    """Scan body for the expert-major MoE prefill path; the merged form consumes
+    the fused [up|gate] stack. Shared by _moe_ffn and _moe_ffn_expert_sharded
+    (only the combine weights differ, and they ride in the xs)."""
+    if merged:
+        def step(acc, ew):
+            gu_e, down_e, comb = ew  # QTensors (2h0,d)/(d,h0), comb (B,T)
+            hb = _gated_split(qmatmul(xb, gu_e, use_pallas=use_pallas), act,
+                              gate_first=False)
+            out_e = qmatmul(hb, down_e, use_pallas=use_pallas)
+            return acc + out_e * comb[..., None], None
+    else:
+        def step(acc, ew):
+            up_e, gate_e, down_e, comb = ew  # QTensors (h0,d)/(d,h0), comb (B,T)
+            hb = qmatmul(xb, up_e, use_pallas=use_pallas) * act(
+                qmatmul(xb, gate_e, use_pallas=use_pallas))
+            out_e = qmatmul(hb, down_e, use_pallas=use_pallas)
+            return acc + out_e * comb[..., None], None
+    return step
+
+
+def _expert_scan_xs(bp, merged, combine):
+    if merged:
+        return (bp["moe_gu"], bp["moe_down"], combine)
+    return (bp["moe_up"], bp["moe_gate"], bp["moe_down"], combine)
 
 
 def _gather_expert(w, idx):
@@ -335,12 +369,14 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
     top_p, top_i = jax.lax.top_k(probs, k)  # (B, T, K)
     weights = top_p / jnp.sum(top_p, axis=-1, keepdims=True)  # renormalize (grokMoeNormWeights)
 
-    el = bp["moe_up"].shape[0]  # shard-local expert count
+    merged = "moe_gu" in bp  # fused up+gate stack (fuse_matvec_groups)
+    gu_stack = bp["moe_gu"] if merged else bp["moe_up"]
+    el = gu_stack.shape[0]  # shard-local expert count
     if axis_name is not None and el != spec.n_experts:
         return _moe_ffn_expert_sharded(xb, bp, spec, axis_name, use_pallas, compress,
                                        top_i, weights, el)
 
-    if use_pallas and b * t == 1 and bp["moe_up"].layout in ("i4p", "i8"):
+    if use_pallas and b * t == 1 and gu_stack.layout in ("i4p", "i8"):
         # Decode through the fused matvec kernels: dynamic_slice each active expert's
         # packed planes out of the stacked (E, ...) QTensor (moving exactly that
         # expert's bytes through HBM — the reference's per-active-expert matmuls,
@@ -352,19 +388,28 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
         out = jnp.zeros_like(xb)
         for j in range(k):
             e = top_i.reshape(k)[j]
-            hb = qmatmul(xb, expert_q(bp["moe_up"], e), use_pallas=True) * act(
-                qmatmul(xb, expert_q(bp["moe_gate"], e), use_pallas=True))
+            if merged:
+                hb = _gated_split(qmatmul(xb, expert_q(bp["moe_gu"], e),
+                                          use_pallas=True), act, gate_first=False)
+            else:
+                hb = qmatmul(xb, expert_q(bp["moe_up"], e), use_pallas=True) * act(
+                    qmatmul(xb, expert_q(bp["moe_gate"], e), use_pallas=True))
             out_e = qmatmul(hb, expert_q(bp["moe_down"], e), use_pallas=True)
             out = out + out_e * weights.reshape(k)[j].astype(xb.dtype)
     elif b * t * k <= spec.n_experts:
         # Decode: gather the K active experts' (sliced) weight matrices per token,
         # dequantize, matmul. Moves exactly the active experts' bytes out of HBM — the
         # same bandwidth shape as the reference's per-expert forward calls.
-        up_w = _gather_expert(bp["moe_up"], top_i).dequantize(dtype=xb.dtype)  # (B,T,K,h0,d)
-        gate_w = _gather_expert(bp["moe_gate"], top_i).dequantize(dtype=xb.dtype)
         down_w = _gather_expert(bp["moe_down"], top_i).dequantize(dtype=xb.dtype)
-        hb = jnp.einsum("btd,btkhd->btkh", xb, up_w) * act(
-            jnp.einsum("btd,btkhd->btkh", xb, gate_w))
+        if merged:
+            gu_w = _gather_expert(bp["moe_gu"], top_i).dequantize(dtype=xb.dtype)
+            hb = _gated_split(jnp.einsum("btd,btkhd->btkh", xb, gu_w), act,
+                              gate_first=False)
+        else:
+            up_w = _gather_expert(bp["moe_up"], top_i).dequantize(dtype=xb.dtype)
+            gate_w = _gather_expert(bp["moe_gate"], top_i).dequantize(dtype=xb.dtype)
+            hb = jnp.einsum("btd,btkhd->btkh", xb, up_w) * act(
+                jnp.einsum("btd,btkhd->btkh", xb, gate_w))
         out = jnp.einsum("btkh,btkdh->btkd", hb, down_w)
         out = jnp.einsum("btkd,btk->btd", out, weights.astype(xb.dtype))
     else:
@@ -374,16 +419,9 @@ def _moe_ffn(xb, bp, spec: ModelSpec, axis_name, use_pallas, compress):
         one_hot = jax.nn.one_hot(top_i, spec.n_experts, dtype=xb.dtype)  # (B,T,K,E)
         combine = jnp.einsum("btke,btk->ebt", one_hot, weights.astype(xb.dtype))
 
-        def expert_step(acc, ew):
-            up_e, gate_e, down_e, comb = ew  # QTensors (h0,d)/(d,h0), comb (B,T)
-            hb = qmatmul(xb, up_e, use_pallas=use_pallas) * act(
-                qmatmul(xb, gate_e, use_pallas=use_pallas))
-            out_e = qmatmul(hb, down_e, use_pallas=use_pallas)
-            return acc + out_e * comb[..., None], None
-
-        out, _ = jax.lax.scan(
-            expert_step, jnp.zeros_like(xb),
-            (bp["moe_up"], bp["moe_gate"], bp["moe_down"], combine))
+        out, _ = jax.lax.scan(_make_expert_step(xb, act, use_pallas, merged),
+                              jnp.zeros_like(xb),
+                              _expert_scan_xs(bp, merged, combine))
     return _maybe_psum(out, axis_name, compress)
 
 
@@ -399,10 +437,22 @@ def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, comp
     act = _act(spec)
     shard = jax.lax.axis_index(axis_name)
     offset = shard * el
+    merged = "moe_gu" in bp
 
     def expert_q(wstack, e):
         return jax.tree_util.tree_map(
             lambda a: jax.lax.dynamic_slice_in_dim(a, e, 1, 0)[0], wstack)
+
+    def expert_hb(row_x, e_loc):
+        """hb for one local expert — merged [up|gate] stack or separate."""
+        if merged:
+            return _gated_split(qmatmul(row_x, expert_q(bp["moe_gu"], e_loc),
+                                        use_pallas=use_pallas), act,
+                                gate_first=False)
+        return qmatmul(row_x, expert_q(bp["moe_up"], e_loc),
+                       use_pallas=use_pallas) * act(
+            qmatmul(row_x, expert_q(bp["moe_gate"], e_loc),
+                    use_pallas=use_pallas))
 
     if t == 1 and b * k <= 2 * spec.n_experts:
         # decode (incl. batched slots): one cond per (row, active expert) — owner
@@ -420,11 +470,8 @@ def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, comp
                 w_j = weights[r, 0, j].astype(xb.dtype)
 
                 def compute(row_x=row_x, e_loc=e_loc):
-                    hb = qmatmul(row_x, expert_q(bp["moe_up"], e_loc),
-                                 use_pallas=use_pallas) * act(
-                        qmatmul(row_x, expert_q(bp["moe_gate"], e_loc),
-                                use_pallas=use_pallas))
-                    return qmatmul(hb, expert_q(bp["moe_down"], e_loc),
+                    return qmatmul(expert_hb(row_x, e_loc),
+                                   expert_q(bp["moe_down"], e_loc),
                                    use_pallas=use_pallas)
 
                 out_e = jax.lax.cond(in_range, compute,
@@ -437,16 +484,9 @@ def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, comp
         combine = jnp.einsum("btke,btk->ebt", one_hot, weights.astype(xb.dtype))
         combine_local = jax.lax.dynamic_slice_in_dim(combine, offset, el, 0)
 
-        def expert_step(acc, ew):
-            up_e, gate_e, down_e, comb = ew
-            hb = qmatmul(xb, up_e, use_pallas=use_pallas) * act(
-                qmatmul(xb, gate_e, use_pallas=use_pallas))
-            out_e = qmatmul(hb, down_e, use_pallas=use_pallas)
-            return acc + out_e * comb[..., None], None
-
-        out, _ = jax.lax.scan(
-            expert_step, jnp.zeros_like(xb),
-            (bp["moe_up"], bp["moe_gate"], bp["moe_down"], combine_local))
+        out, _ = jax.lax.scan(_make_expert_step(xb, act, use_pallas, merged),
+                              jnp.zeros_like(xb),
+                              _expert_scan_xs(bp, merged, combine_local))
     return _maybe_psum(out, axis_name, compress)
 
 

@@ -119,43 +119,57 @@ def _decode_layout(t: QTensor, tp: int, col_sharded: bool) -> QTensor:
     return t.to_i8_layout()
 
 
-def _concat_rows_grouped(tensors: list[QTensor], tp: int) -> QTensor:
+def _concat_rows_grouped(tensors: list[QTensor], tp: int, row_axis: int = 1
+                         ) -> QTensor:
     """Concatenate planar QTensors along the row (out) axis, interleaved per TP
     group: the result's rows are [t0_g0, t1_g0, ..., t0_g1, t1_g1, ...] where g_i
     is shard i's row slice of each input, so a P('tp')-on-rows placement lands each
     shard exactly its own inputs' slices, contiguous. Quant blocks run along the
     *in* axis, so row concatenation never touches block structure (numerics are
-    bit-identical to the separate tensors)."""
+    bit-identical to the separate tensors).
+
+    row_axis: index of the out axis in the leaves — 1 for stacked dense weights
+    (L, out, ...), 2 for stacked MoE expert stacks (L, E, out, ...)."""
     ft = tensors[0].ftype
     assert all(t.layout == "planar" and t.ftype == ft for t in tensors)
 
     def cat(leaves):
-        # planar leaf shapes: data (L, out, nb, 16|32), scales (L, out, nb)
+        # planar leaf shapes: data (..., out, nb, 16|32), scales (..., out, nb)
         parts = []
         for a in leaves:
-            rows = a.shape[1]
+            rows = a.shape[row_axis]
             assert rows % tp == 0, (a.shape, tp)
-            parts.append(a.reshape(a.shape[0], tp, rows // tp, *a.shape[2:]))
-        return np.concatenate(parts, axis=2).reshape(
-            leaves[0].shape[0], -1, *leaves[0].shape[2:])
+            parts.append(a.reshape(*a.shape[:row_axis], tp, rows // tp,
+                                   *a.shape[row_axis + 1:]))
+        out = np.concatenate(parts, axis=row_axis + 1)
+        return out.reshape(*out.shape[:row_axis], -1,
+                           *out.shape[row_axis + 2:])
 
     return QTensor(ft, cat([np.asarray(t.data) for t in tensors]),
-                   cat([np.asarray(t.scales) for t in tensors]))
+                   cat([np.asarray(t.scales) for t in tensors]), row_groups=tp)
 
 
 # merged matvec groups: members share the same activation vector, so one kernel
 # launch with the row blocks concatenated replaces 3 (QKV) / 2 (gate+up) launches
-# — fewer grid setups and quantize/Xexp prologues per layer. The reference has no
-# counterpart (its task lists issue one matmul task per tensor,
-# llama2-tasks.cpp:246-276); this is TPU launch-overhead engineering.
-_FUSE_GROUPS = {"wqkv": ("wq", "wk", "wv"), "w13": ("w1", "w3")}
+# — fewer grid setups and quantize/Xexp prologues per layer. moe_gu merges each
+# expert's up+gate the same way (halving per-active-expert launches on the MoE
+# decode path). The reference has no counterpart (its task lists issue one
+# matmul task per tensor, llama2-tasks.cpp:246-276); this is TPU launch-overhead
+# engineering.
+_FUSE_GROUPS = {"wqkv": ("wq", "wk", "wv"), "w13": ("w1", "w3"),
+                "moe_gu": ("moe_up", "moe_gate")}
+# out-axis index within each group's stacked planar leaves
+_FUSE_ROW_AXIS = {"wqkv": 1, "w13": 1, "moe_gu": 2}
 
 
-def fuse_matvec_groups(blocks: Params, spec: ModelSpec | None, tp: int) -> Params:
-    """Replace wq/wk/wv -> wqkv and w1/w3 -> w13 with row-concatenated (TP-group
-    interleaved) planar tensors where safe. Skipped per group when a member is not
-    kernel-convertible or (QKV) when KV-head replication is active (tp >
-    n_kv_heads expands wk/wv rows at shard time, after this runs)."""
+def fuse_matvec_groups(blocks: Params, spec: ModelSpec | None, tp: int,
+                       moe_sharding: str = "slice") -> Params:
+    """Replace wq/wk/wv -> wqkv, w1/w3 -> w13, moe_up/moe_gate -> moe_gu with
+    row-concatenated (TP-group interleaved) planar tensors where safe. Skipped
+    per group when a member is not kernel-convertible or (QKV) when KV-head
+    replication is active (tp > n_kv_heads expands wk/wv rows at shard time,
+    after this runs). Under expert sharding the MoE stacks shard by whole
+    experts, not rows, so moe_gu concatenates with NO group interleave."""
     from ..parallel.sharding import effective_kv_heads
 
     out = dict(blocks)
@@ -166,14 +180,18 @@ def fuse_matvec_groups(blocks: Params, spec: ModelSpec | None, tp: int) -> Param
             continue
         if len({t.ftype for t in ts}) != 1:
             continue
-        if any(t.shape[1] % tp for t in ts):
+        row_axis = _FUSE_ROW_AXIS[fused]
+        groups = tp
+        if fused == "moe_gu" and moe_sharding == "expert":
+            groups = 1  # whole experts shard over tp; rows stay unsharded
+        if any(t.shape[row_axis] % groups for t in ts):
             continue
         if fused == "wqkv":
             if spec is None and tp > 1:
                 continue  # can't rule out KV replication without the spec
             if spec is not None and effective_kv_heads(spec, tp) != spec.n_kv_heads:
                 continue  # replication rewrites wk/wv rows later; keep separate
-        out[fused] = _concat_rows_grouped(ts, tp)
+        out[fused] = _concat_rows_grouped(ts, groups, row_axis=row_axis)
         for m in members:
             del out[m]
     return out
@@ -197,8 +215,9 @@ def prepare_for_pallas(params: Params, tp: int = 1,
     out: Params = {"embedding": params["embedding"], "blocks": {},
                    "rms_final": params["rms_final"]}
     fuse = fuse and not os.environ.get("DLT_NO_FUSE")  # field kill-switch
-    blocks = fuse_matvec_groups(params["blocks"], spec, tp) if fuse \
-        else params["blocks"]
+    blocks = (fuse_matvec_groups(params["blocks"], spec, tp,
+                                 moe_sharding=moe_sharding) if fuse
+              else params["blocks"])
     for name, t in blocks.items():
         if ((name in _DENSE_MATMULS or name in _FUSE_GROUPS)
                 and _kernel_convertible(t, stacked=True)):

@@ -44,6 +44,7 @@ _BLOCK_SPECS = {
     "router": P(),                    # replicated (root-only in reference)
     "moe_up": P(None, None, AXIS_TP),    # (L, E, hidden->tp, dim)
     "moe_gate": P(None, None, AXIS_TP),
+    "moe_gu": P(None, None, AXIS_TP),    # (L, E, 2*hidden->tp, dim), merged up+gate
     "moe_down": P(None, None, None, AXIS_TP),  # (L, E, dim, hidden->tp)
     "rms_att": P(),
     "rms_ffn": P(),
@@ -61,6 +62,7 @@ _BLOCK_SPECS = {
 _EP_SPECS = {
     "moe_up": P(None, AXIS_TP),    # (L, E->tp, hidden, dim), experts whole
     "moe_gate": P(None, AXIS_TP),
+    "moe_gu": P(None, AXIS_TP),    # (L, E->tp, 2*hidden, dim), merged up+gate
     "moe_down": P(None, AXIS_TP),  # (L, E->tp, dim, hidden)
 }
 

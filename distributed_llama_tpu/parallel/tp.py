@@ -35,7 +35,8 @@ def _expand_pspec_tree(params: dict[str, Any], pspecs: dict[str, Any]):
         elif isinstance(v, QTensor):
             spec = pspecs[k]
             out[k] = QTensor(v.ftype, spec, spec if v.scales is not None else None,
-                             layout=v.layout, groups=v.groups)
+                             layout=v.layout, groups=v.groups,
+                             row_groups=v.row_groups)
         else:
             out[k] = pspecs[k]
     return out
@@ -76,6 +77,20 @@ def shard_params(params: dict[str, Any], mesh: Mesh,
     When tp > n_kv_heads, wk/wv rows are replicated per KV head (effective_kv_heads)
     before placement, lifting the reference's nSlices <= nKvHeads limit."""
     tp = mesh.shape[AXIS_TP]
+    # fused matvec groups carry the TP-group count their rows were interleaved
+    # with (models/params.py fuse_matvec_groups); placement on a mismatched
+    # mesh/moe_sharding would silently scramble the member split — fail loudly
+    from ..models.params import _FUSE_GROUPS
+
+    for name, t in params["blocks"].items():
+        if name not in _FUSE_GROUPS or not isinstance(t, QTensor):
+            continue
+        expected = 1 if (name == "moe_gu" and moe_sharding == "expert") else tp
+        assert t.row_groups == expected, (
+            f"{name} was fused with row interleave {t.row_groups}, but this "
+            f"mesh shards it over {expected} group(s) (tp={tp}, "
+            f"moe_sharding={moe_sharding}) — re-run prepare_for_pallas with "
+            "the deployment's tp/moe_sharding")
     if spec is not None:
         check_divisibility(spec, tp, moe_sharding=moe_sharding)
         hk_eff = effective_kv_heads(spec, tp)

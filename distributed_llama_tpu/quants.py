@@ -241,6 +241,12 @@ class QTensor:
     # (= the TP degree for in-axis-sharded tensors, so each shard's slice is a
     # self-contained pack). 1 elsewhere.
     groups: int = 1
+    # fused matvec groups only (models/params.py fuse_matvec_groups): the
+    # TP-group count the member ROWS were interleaved with at fuse time. Carried
+    # through layout conversion so shard time can verify the placement matches
+    # the interleave (a mismatch would silently scramble the member split). 1
+    # for unfused tensors.
+    row_groups: int = 1
 
     @property
     def shape(self) -> tuple[int, ...]:
@@ -256,19 +262,22 @@ class QTensor:
         raise ValueError(self.ftype)
 
     def tree_flatten(self):
+        aux = (self.ftype, self.scales is not None, self.layout, self.groups,
+               self.row_groups)
         if self.scales is None:
-            return (self.data,), (self.ftype, False, self.layout, self.groups)
-        return (self.data, self.scales), (self.ftype, True, self.layout, self.groups)
+            return (self.data,), aux
+        return (self.data, self.scales), aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        ftype, has_scales, layout, groups = aux
+        ftype, has_scales, layout, groups, row_groups = aux
         if has_scales:
             data, scales = children
         else:
             (data,) = children
             scales = None
-        return cls(ftype=ftype, data=data, scales=scales, layout=layout, groups=groups)
+        return cls(ftype=ftype, data=data, scales=scales, layout=layout,
+                   groups=groups, row_groups=row_groups)
 
     def to_i8_layout(self) -> "QTensor":
         """Expand planar Q40/Q80 into int8 planes for the MXU matvec kernel (pallas_q8).
@@ -284,7 +293,8 @@ class QTensor:
 
             nat = native.q40_to_i8(np.asarray(self.data), np.asarray(self.scales))
             if nat is not None:
-                return QTensor(self.ftype, nat[0], nat[1], layout="i8")
+                return QTensor(self.ftype, nat[0], nat[1], layout="i8",
+                               row_groups=self.row_groups)
             packed = np.asarray(self.data)
             lo = (packed & 0x0F).astype(np.int8) - 8  # elements 0..15 of each block
             hi = (packed >> 4).astype(np.int8) - 8  # elements 16..31
@@ -296,7 +306,8 @@ class QTensor:
         k = vals.shape[-2] * QK
         data = vals.reshape(*vals.shape[:-2], k)
         scales32 = np.asarray(self.scales, dtype=np.float32)
-        return QTensor(self.ftype, data, scales32, layout="i8")
+        return QTensor(self.ftype, data, scales32, layout="i8",
+                       row_groups=self.row_groups)
 
     def to_i4p_layout(self, col_groups: int = 1) -> "QTensor":
         """Repack planar Q40 into split-plane nibbles for the 4-bit MXU matvec kernel
@@ -325,7 +336,8 @@ class QTensor:
             np.asarray(self.scales, dtype=np.float16)).view(np.int16)
         nat = native.q40_to_i4p(packed, col_groups)
         if nat is not None:
-            return QTensor(self.ftype, nat, scales16, layout="i4p", groups=col_groups)
+            return QTensor(self.ftype, nat, scales16, layout="i4p",
+                           groups=col_groups, row_groups=self.row_groups)
         lo = (packed & 0x0F).astype(np.uint8)  # block elements 0..15
         hi = (packed >> 4).astype(np.uint8)  # block elements 16..31
         q = np.concatenate([lo, hi], axis=-1)  # (..., nb, 32) natural order, in [0,16)
@@ -336,7 +348,8 @@ class QTensor:
         q = q.reshape(*lead, col_groups, kl)
         data = q[..., : kl // 2] | (q[..., kl // 2 :] << 4)
         data = data.reshape(*lead, k // 2)
-        return QTensor(self.ftype, data, scales16, layout="i4p", groups=col_groups)
+        return QTensor(self.ftype, data, scales16, layout="i4p",
+                       groups=col_groups, row_groups=self.row_groups)
 
     def _i4p_unpack(self, xp):
         """Split-plane nibbles -> natural-order values (..., K) minus the 8 offset."""

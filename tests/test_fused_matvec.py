@@ -139,6 +139,94 @@ def test_fused_decode_sharded_kernel_path():
     assert rel < 0.03, rel
 
 
+def test_moe_gu_fused_roundtrip_and_forward():
+    """moe_up/moe_gate merge into moe_gu (per-expert [up|gate], TP-group
+    interleaved on the hidden axis): bit-exact round-trip and matching Mixtral
+    decode through the kernel path."""
+    spec = ModelSpec(arch_type=ArchType.MIXTRAL, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+                     seq_len=16, n_experts=4, n_active_experts=2,
+                     rope_type=RopeType.FALCON).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=17)
+
+    fused = fuse_matvec_groups(params["blocks"], spec, tp=2)
+    got = fused["moe_gu"].to_numpy()  # (L, E, 2h, d)
+    up = params["blocks"]["moe_up"].to_numpy()
+    gate = params["blocks"]["moe_gate"].to_numpy()
+    rows = []
+    for g in range(2):
+        for m in (up, gate):
+            r = m.shape[2] // 2
+            rows.append(m[:, :, g * r:(g + 1) * r])
+    np.testing.assert_array_equal(got, np.concatenate(rows, axis=2))
+
+    # decode through the kernel path (tp=1): fused == unfused
+    rope = RopeTables.create(spec)
+    unfused = prepare_for_pallas(params, fuse=False)
+    fusedp = prepare_for_pallas(params, spec=spec)
+    assert "moe_gu" in fusedp["blocks"]
+    tok = jnp.asarray([[5]])
+    kc, vc = init_kv_cache(spec)
+    want, _, _ = forward(unfused, spec, rope, tok, kc, vc, jnp.int32(0),
+                         use_pallas=True)
+    kc, vc = init_kv_cache(spec)
+    got_l, _, _ = forward(fusedp, spec, rope, tok, kc, vc, jnp.int32(0),
+                          use_pallas=True)
+    got_l, want = np.asarray(got_l), np.asarray(want)
+    rel = np.abs(got_l - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 1e-5, rel
+
+
+def test_moe_gu_expert_sharded_matches():
+    """Expert-parallel mesh (whole experts over tp) with the merged moe_gu
+    stack == unsharded planar forward."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
+                                                   make_sharded_forward,
+                                                   shard_params)
+
+    spec = ModelSpec(arch_type=ArchType.MIXTRAL, dim=128, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=128,
+                     seq_len=16, n_experts=4, n_active_experts=2,
+                     rope_type=RopeType.FALCON).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=19)
+    rope = RopeTables.create(spec)
+    tok = jnp.asarray([[5]])
+
+    kc, vc = init_kv_cache(spec)
+    want, _, _ = forward(params, spec, rope, tok, kc, vc, jnp.int32(0))
+
+    mesh = make_mesh(tp=4)
+    pp = shard_params(prepare_for_pallas(params, tp=4, moe_sharding="expert",
+                                         spec=spec),
+                      mesh, spec, moe_sharding="expert")
+    assert "moe_gu" in pp["blocks"]
+    step = make_sharded_forward(spec, mesh, pp, use_pallas=True,
+                                donate_cache=False, moe_sharding="expert")
+    kc, vc = init_sharded_kv_cache(spec, mesh)
+    got, _, _ = step(pp, rope, tok, kc, vc, jnp.int32(0))
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 0.03, rel
+
+
+def test_row_groups_mismatch_fails_loudly():
+    """Fusing for one tp and sharding on another would silently scramble the
+    member split — shard_params must refuse (row_groups provenance check)."""
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import shard_params
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=23)
+    pp = prepare_for_pallas(params, tp=1, spec=spec)  # interleave for tp=1
+    assert pp["blocks"]["wqkv"].row_groups == 1
+    mesh = make_mesh(tp=2)
+    import pytest
+
+    with pytest.raises(AssertionError, match="row interleave"):
+        shard_params(pp, mesh, spec)
+
+
 def test_fuse_skipped_under_kv_replication():
     """tp > n_kv_heads engages KV-head row replication (parallel/tp.py), which
     rewrites wk/wv AFTER fusion would run — fuse must decline and leave the

@@ -156,7 +156,9 @@ def test_moe_decode_kernel_path_matches_planar():
     params = init_random_params(spec, FloatType.Q40, seed=13)
     rope = RopeTables.create(spec)
     pp = prepare_for_pallas(params)
-    assert pp["blocks"]["moe_up"].layout == "i4p"
+    # up+gate merge into the moe_gu stack (fuse_matvec_groups)
+    assert pp["blocks"]["moe_gu"].layout == "i4p"
+    assert pp["blocks"]["moe_gu"].shape[-2] == 2 * spec.hidden_dim
 
     tok = jnp.asarray([[5]])
     kc, vc = init_kv_cache(spec)
