@@ -32,6 +32,12 @@ run python bench.py --steps 64
 # kernel layout A/B at the model level
 run python bench.py --steps 64 --layout i8
 
+# merged projection launches A/B (wqkv/w13 fusion, default on)
+run python bench.py --steps 64 --no-fuse
+
+# fused rmsnorm+quantize prologue kernels (opt-in until this A/B lands)
+run python bench.py --steps 64 --prologue
+
 # cache-write discipline A/B (deferred = default; inscan carries the caches
 # through the layer scan — the round-4 trace blamed its carry copies for a
 # third of the step)
@@ -44,8 +50,10 @@ run python bench.py --steps 64 --window 2048
 run python bench.py --steps 64 --device-loop 8
 run python bench.py --steps 64 --device-loop 32
 
-# prefill throughput (chunked prefill is a capability win over the reference)
+# prefill throughput (chunked prefill is a capability win over the reference;
+# cost model in perf/PROFILE.md)
 run python bench.py --prefill 64 --steps 16
+run python bench.py --prefill 128 --steps 16
 
 # the other BASELINE.json configs
 run python bench.py --arch tinyllama_1_1b --steps 64

@@ -1,0 +1,99 @@
+"""Warm-runner -> driver bench handoff (bench.py + perf/persistent_bench.py).
+
+The round-4 failure mode: the driver's fresh `python bench.py` died on a dead
+tunnel (value 0.0) while a warm runner held the only good measurement of the
+day. The handoff publishes the runner's headline to BENCH_latest.json and
+bench.py reports it, with provenance, when its own probe fails. These tests run
+bench.py as a real subprocess with an unreachable backend (JAX_PLATFORMS=tpu in
+an env with no TPU plugin) and pin the protocol:
+
+- fresh handoff file  -> rc 0, value passed through, provenance fields present
+- stale handoff file  -> rc 2, value 0.0, explicit staleness in the error
+- non-headline config -> rc 2 (never silently reports the headline's number)
+- drill env           -> rc 2 (the fallback drill must not "pass" via handoff)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LATEST = os.path.join(REPO, "BENCH_latest.json")
+
+RESULT = {"metric": "llama2_7b_q40_decode_tok_s", "value": 32.35,
+          "unit": "tok/s", "vs_baseline": 3.293, "layout": "i4p",
+          "cache_write": "deferred"}
+
+
+def _run_bench(extra_args=(), extra_env=None):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
+    # no axon sitecustomize, no TPU plugin: backend init fails fast and the
+    # probe path (not a wedge-hang) is exercised
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "tpu"
+    env["DLT_PROBE_TIMEOUT"] = "30"
+    env.update(extra_env or {})
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--steps", "4",
+         *extra_args],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    line = p.stdout.strip().splitlines()[-1] if p.stdout.strip() else "{}"
+    return p.returncode, json.loads(line)
+
+
+@pytest.fixture
+def handoff_file():
+    def write(age_s):
+        payload = {"result": dict(RESULT), "captured_unix": time.time() - age_s,
+                   "captured_at": "test", "argv": "bench.py --steps 32"}
+        with open(LATEST, "w") as f:
+            json.dump(payload, f)
+    yield write
+    if os.path.exists(LATEST):
+        os.remove(LATEST)
+
+
+def test_fresh_handoff_reported_with_provenance(handoff_file):
+    handoff_file(age_s=600)
+    rc, out = _run_bench()
+    assert rc == 0
+    assert out["value"] == RESULT["value"]
+    assert out["provenance"] == "warm-runner"
+    assert 590 < out["age_s"] < 700
+    assert out["warm_runner_argv"] == "bench.py --steps 32"
+    assert "probe_failure_at_capture" in out
+
+
+def test_stale_handoff_refused(handoff_file):
+    handoff_file(age_s=30 * 3600)
+    rc, out = _run_bench()
+    assert rc == 2
+    assert out["value"] == 0.0
+    assert "stale" in out["error"]
+
+
+def test_non_headline_config_never_borrows_headline(handoff_file):
+    handoff_file(age_s=600)
+    rc, out = _run_bench(extra_args=("--layout", "i8"))
+    assert rc == 2
+    assert out["value"] == 0.0
+
+
+def test_drill_env_never_borrows_headline(handoff_file):
+    handoff_file(age_s=600)
+    rc, out = _run_bench(extra_env={"DLT_FORCE_I4P_FAILURE": "1"})
+    assert rc == 2
+    assert out["value"] == 0.0
+
+
+def test_no_handoff_file_reports_unreachable():
+    assert not os.path.exists(LATEST)
+    rc, out = _run_bench()
+    assert rc == 2
+    assert out["value"] == 0.0
+    assert "TPU unreachable" in out["error"]
