@@ -69,14 +69,11 @@ def qmatmul_q80(xq: jax.Array, sx: jax.Array, w: QTensor, *,
                                       interpret=jax.default_backend() != "tpu")
                 return y.reshape(1, 1, y.shape[0]).astype(out_dtype)
         elif w.layout == "i8":
-            from .pallas_q8 import (_q8_matvec, block_diag_scatter,
-                                    q8_decode_supported)
+            from .pallas_q8 import _q8_matvec_inline, q8_decode_supported
 
             if q8_decode_supported(w):
-                nb = sx.shape[-1]
-                xexp = block_diag_scatter(xq.reshape(-1), nb)
-                y = _q8_matvec(xexp, sx, w.data, w.scales,
-                               interpret=jax.default_backend() != "tpu")
+                y = _q8_matvec_inline(xq, sx, w.data, w.scales,
+                                      interpret=jax.default_backend() != "tpu")
                 return y.reshape(1, 1, y.shape[0]).astype(out_dtype)
     xhat = jnp_dequantize_i8(xq, sx, dtype=jnp.float32)  # (1, K)
     wd = w.dequantize(dtype=jnp.float32)

@@ -10,12 +10,10 @@ the whole prologue into ONE VPU pass per activation:
     rmsnorm_quantize_q80:  x (1,K) f32/bf16, w (K,)  ->  xq (1,K) i8, sx (1,nb) f32
     quantize_q80_row:      x (1,K)                   ->  xq (1,K) i8, sx (1,nb) f32
 
-The outputs feed ops.matmul.qmatmul_q80. For i4p weights that routes into the
-inline-Xexp matvec variant (scatter built in kernel scratch,
-pallas_q4._matvec_kernel_inline) so the quantized row is the only activation HBM
-traffic; for i8 weights the block-diagonal Xexp is still materialized in XLA (no
-inline q8 variant yet) — there the prologue saves only the norm/quantize fusions,
-not activation HBM bytes.
+The outputs feed ops.matmul.qmatmul_q80, which routes into the inline-Xexp
+matvec variants for BOTH layouts (scatter built in kernel scratch —
+pallas_q4._matvec_kernel_inline / pallas_q8._matvec_kernel_inline), so the
+quantized row is the only activation HBM traffic.
 
 Numerics: the rmsnorm reduction runs in f32 with the same mean-square + eps
 formula as ops.kernels.rmsnorm (reference funcs.cpp rms(), eps inside the mean);

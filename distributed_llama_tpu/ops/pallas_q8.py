@@ -40,6 +40,19 @@ def _matvec_kernel(xexp_ref, sx_ref, w_ref, s_ref, o_ref):
     o_ref[:] = jnp.sum(y, axis=1, keepdims=True)
 
 
+def _matvec_kernel_inline(xq_ref, sx_ref, w_ref, s_ref, o_ref, xexp_ref):
+    """Inline-Xexp variant (the pallas_q4 pattern): the raw int8 activation row
+    (K bytes of HBM instead of K*nb) is scattered block-diagonally into VMEM
+    scratch at grid step 0 and reused by every row block."""
+    _, nb = xexp_ref.shape
+
+    @pl.when(pl.program_id(0) == 0)
+    def _build():
+        xexp_ref[:] = block_diag_scatter(xq_ref[0], nb)
+
+    _matvec_kernel(xexp_ref, sx_ref, w_ref, s_ref, o_ref)
+
+
 def _matvec_kernel_f32(xexp_ref, sx_ref, w_ref, s_ref, o_ref):
     # precise path: activations stay f32 (no Q80 step); weights convert once to f32.
     # Used by parity tests; decode perf path is the int8 kernel above.
@@ -104,6 +117,32 @@ def _q8_matvec(xexp, sx, w8, scales, *, interpret: bool = False, precise: bool =
         out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
         interpret=interpret,
     )(xexp, sx, w8, scales)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q8_matvec_inline(xq, sx, w8, scales, *, interpret: bool = False):
+    """Inline-Xexp variant: xq (1, K) int8 streamed to VMEM; the block-diagonal
+    operand lives only in kernel scratch."""
+    _, k = xq.shape
+    n, k2 = w8.shape
+    nb = k // QK
+    assert k2 == k and scales.shape == (n, nb) and nb * QK == k, (
+        xq.shape, w8.shape, scales.shape)
+    bn = _pick_bn(n, k)
+    return pl.pallas_call(
+        _matvec_kernel_inline,
+        grid=(pl.cdiv(n, bn),),
+        in_specs=[
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nb), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, nb), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bn, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((k, nb), jnp.int8)],
+        interpret=interpret,
+    )(xq, sx, w8, scales)
 
 
 def _quantize_row(x_row: jax.Array, nb: int):

@@ -126,3 +126,47 @@ def test_prologue_engine_generation_matches():
     eng = Engine(spec, params, tp=1, use_pallas=True, fused_prologue=True)
     got, _ = eng.generate([1, 7, 3], 8, Sampler(spec.vocab_size, temperature=0.0))
     assert got == want
+
+
+def test_q8_inline_matvec_matches_xexp_variant():
+    """The new i8 inline-Xexp matvec (scratch scatter) must reproduce the
+    Xexp-materializing variant exactly — same int8 dot, same epilogue."""
+    import jax
+
+    from distributed_llama_tpu.ops.pallas_q8 import (_q8_matvec,
+                                                     _q8_matvec_inline,
+                                                     block_diag_scatter)
+
+    rng = np.random.RandomState(3)
+    n, k = 48, 256
+    nb = k // QK
+    xq = jnp.asarray(rng.randint(-127, 128, (1, k)).astype(np.int8))
+    sx = jnp.asarray(rng.rand(1, nb).astype(np.float32) * 0.01)
+    w8 = jnp.asarray(rng.randint(-8, 8, (n, k)).astype(np.int8))
+    scales = jnp.asarray(rng.rand(n, nb).astype(np.float32) * 0.01)
+
+    xexp = block_diag_scatter(xq.reshape(k), nb)
+    want = _q8_matvec(xexp, sx, w8, scales, interpret=True)
+    got = _q8_matvec_inline(xq, sx, w8, scales, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_prologue_i8_layout_forward_matches():
+    """Prologue decode over i8-layout weights (Q80 file type) == plain kernel
+    path — exercises qmatmul_q80's i8 inline route end-to-end."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q80, seed=29)
+    rope = RopeTables.create(spec)
+    pp = prepare_for_pallas(params, spec=spec)
+    assert pp["blocks"]["wqkv"].layout == "i8"
+
+    tok = jnp.asarray([[5]])
+    kc, vc = init_kv_cache(spec)
+    want, _, _ = forward(pp, spec, rope, tok, kc, vc, jnp.int32(0),
+                         use_pallas=True)
+    kc, vc = init_kv_cache(spec)
+    got, _, _ = forward(pp, spec, rope, tok, kc, vc, jnp.int32(0),
+                        use_pallas=True, fused_prologue=True)
+    got, want = np.asarray(got), np.asarray(want)
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 2e-5, rel
