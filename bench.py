@@ -240,6 +240,10 @@ def main():
                     help="fused rmsnorm+quantize prologue kernels "
                          "(ops/pallas_prologue.py) feeding the inline-Xexp "
                          "matvec variants — opt-in until the hardware A/B lands")
+    ap.add_argument("--prefill-kernel", action="store_true",
+                    help="fused dequant-matmul for M>1 (ops/pallas_q4_mm.py): "
+                         "weights stream once at 4-bit density instead of the "
+                         "XLA dequant path — opt-in until the hardware A/B lands")
     args = ap.parse_args()
 
     if not os.environ.get("DLT_WARM_RUNNER") and os.environ.get("JAX_PLATFORMS") != "cpu":
@@ -296,7 +300,8 @@ def main():
         is_headline = all(
             getattr(args, k) == ap.get_default(k)
             for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
-                      "window", "cache_write", "no_fuse", "prologue")
+                      "window", "cache_write", "no_fuse", "prologue",
+                      "prefill_kernel")
         ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
         if is_headline and os.path.exists(HANDOFF_LATEST):
             try:
@@ -375,27 +380,32 @@ def main():
         and turned round 3's lowering failure into RESOURCE_EXHAUSTED
         (BENCH_r03.json). Capture the message only, clear the traceback, and
         gc.collect() before re-synthesizing."""
-        ladder = [(layout, args.cache_write, args.prologue)]
+        ladder = [(layout, args.cache_write, args.prologue, args.prefill_kernel)]
+        if args.prefill_kernel:
+            # dequant-matmul failure alone: drop it first, keep everything else
+            ladder.append((layout, args.cache_write, args.prologue, False))
         if args.prologue:
-            # prologue-kernel failure alone: drop it first, keep everything else
-            ladder.append((layout, args.cache_write, False))
+            # prologue-kernel failure alone: drop it next
+            ladder.append((layout, args.cache_write, False, False))
         if args.cache_write != "inscan":
             # deferred/fused-attention failure: keep the better 4-bit layout
-            ladder.append((layout, "inscan", False))
+            ladder.append((layout, "inscan", False, False))
         if layout == "i4p":
             if args.cache_write != "inscan":
                 # q4-kernel failure alone: keep the deferred discipline
-                ladder.append(("i8", args.cache_write, False))
-            ladder.append(("i8", "inscan", False))
+                ladder.append(("i8", args.cache_write, False, False))
+            ladder.append(("i8", "inscan", False, False))
         reasons = []
-        for attempt, (lay, cw, prol) in enumerate(ladder):
+        for attempt, (lay, cw, prol, pk) in enumerate(ladder):
             state["cache_write"] = cw
             state["prologue"] = prol
+            state["use_pallas"] = ("all" if (pk and on_tpu) else on_tpu)
             try:
                 return make_and_warm(*build(lay))
             except Exception as e:
                 reasons.append(
-                    f"{lay}/{cw}{'/prologue' if prol else ''}: "
+                    f"{lay}/{cw}{'/prologue' if prol else ''}"
+                    f"{'/prefill-kernel' if pk else ''}: "
                     f"{type(e).__name__}: {e}"[:200])
                 e.__traceback__ = None
                 del e  # drop the exception (and its frame refs) entirely
@@ -439,7 +449,8 @@ def main():
 
         def warm_prefill(params, kc, vc):
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
-                                        use_pallas=on_tpu, donate_cache=True,
+                                        use_pallas=state["use_pallas"],
+                                        donate_cache=True,
                                         attn_window=pwindow,
                                         cache_write=state["cache_write"],
                                         fused_prologue=state["prologue"])
@@ -465,6 +476,30 @@ def main():
             "ms_per_chunk": round(dt_all / n_disp * 1e3, 2),
             "prologue": False,  # prologue is decode-only (t == 1)
         }
+        # report the EFFECTIVE kernel engagement: the dequant-matmul gates
+        # per-weight (q4_mm_supported), so an A/B record must say how much of
+        # the weight bytes actually took the kernel, not what was requested
+        if state["use_pallas"] == "all":
+            from distributed_llama_tpu.ops.pallas_q4_mm import q4_mm_supported
+
+            eng_b = tot_b = 0
+            tensors = list(state["params"]["blocks"].values()) + [
+                state["params"]["wcls"]]
+            for w in tensors:
+                if not (isinstance(w, QTensor)
+                        and w.ftype in (FloatType.Q40, FloatType.Q80)):
+                    continue
+                nb_bytes = w.nbytes()
+                tot_b += nb_bytes
+                # kernel sees the per-layer (and per-expert) 2-D slice
+                d2 = QTensor(w.ftype, w.data.reshape(-1, w.data.shape[-1]),
+                             None, layout=w.layout, groups=w.groups)
+                if q4_mm_supported(d2, t_chunk):
+                    eng_b += nb_bytes
+            out["prefill_kernel"] = eng_b == tot_b and tot_b > 0
+            out["prefill_kernel_coverage"] = round(eng_b / max(tot_b, 1), 3)
+        else:
+            out["prefill_kernel"] = False
         if "fallback_reason" in state:
             out["fallback_reason"] = state["fallback_reason"]
         print(json.dumps(out))
@@ -478,7 +513,7 @@ def main():
 
         def warm_loop(params, kc, vc):
             loop = make_decode_loop(spec, mesh, params, chunk, mode="greedy",
-                                    dtype=dtype, use_pallas=on_tpu,
+                                    dtype=dtype, use_pallas=state["use_pallas"],
                                     attn_window=window,
                                     cache_write=state["cache_write"],
                                     fused_prologue=state["prologue"])
@@ -499,7 +534,8 @@ def main():
     else:
         def warm_step(params, kc, vc):
             step = make_sharded_forward(spec, mesh, params, dtype=dtype,
-                                        use_pallas=on_tpu, donate_cache=True,
+                                        use_pallas=state["use_pallas"],
+                                        donate_cache=True,
                                         attn_window=window,
                                         cache_write=state["cache_write"],
                                         fused_prologue=state["prologue"])

@@ -25,10 +25,16 @@ import jax.numpy as jnp
 from ..quants import QTensor
 
 
-def qmatmul(x: jax.Array, w: QTensor, *, use_pallas: bool = False,
+def qmatmul(x: jax.Array, w: QTensor, *, use_pallas: bool | str = False,
             out_dtype=None) -> jax.Array:
-    """y = x @ W^T for W of logical shape (out, in); x: (..., in) -> (..., out)."""
-    if use_pallas and math.prod(x.shape[:-1]) == 1:
+    """y = x @ W^T for W of logical shape (out, in); x: (..., in) -> (..., out).
+
+    use_pallas: False = XLA everywhere; True = fused kernels for decode (one
+    activation row); "all" = additionally the fused dequant-matmul for M>1
+    (prefill / batched decode — ops/pallas_q4_mm.py, opt-in until the hardware
+    A/B lands)."""
+    m = math.prod(x.shape[:-1])
+    if use_pallas and m == 1:
         if w.layout == "i4p":
             from .pallas_q4 import q4_decode_supported, q4_matvec
 
@@ -39,6 +45,11 @@ def qmatmul(x: jax.Array, w: QTensor, *, use_pallas: bool = False,
 
             if q8_decode_supported(w):
                 return q8_matvec(x, w, out_dtype=out_dtype or x.dtype)
+    if use_pallas == "all" and m > 1 and w.layout == "i4p":
+        from .pallas_q4_mm import q4_matmul, q4_mm_supported
+
+        if q4_mm_supported(w, m):
+            return q4_matmul(x, w, out_dtype=out_dtype or x.dtype)
     wd = w.dequantize(dtype=x.dtype)
     y = jax.lax.dot_general(
         x, wd,

@@ -1,0 +1,148 @@
+"""Fused 4-bit dequant-matmul — the prefill / batched-decode counterpart of the
+q4 matvec kernel.
+
+The decode matvec (ops/pallas_q4.py) is a T=1 tool: its block-diagonal Xexp
+trick needs one activation row. Prefill (T>1) and batched decode (B>1) run the
+XLA dequant+dot path (ops/matmul.py), which dequantizes the i4p planes to bf16
+operands that XLA may MATERIALIZE through HBM (~3.6x the packed bytes at 7B;
+perf/PROFILE.md's prefill cost model). This kernel keeps the dequant in VMEM:
+each grid step loads a packed (bn, bkp) nibble tile + its f16-bit scales,
+decodes to bf16 in registers, and feeds the MXU — weights stream from HBM
+exactly once at the file's own 0.5625 B/weight density regardless of M.
+
+Split-plane addressing: i4p byte column c holds the LOW nibble of element c and
+the HIGH nibble of element K/2 + c (QTensor.to_i4p_layout), so one packed tile
+covers two disjoint K-ranges; the kernel takes the activation block TWICE with
+block-index maps offset by K/2 (x_lo / x_hi views of the same array) and the
+scales likewise (s_lo / s_hi).
+
+Mosaic portability (perf/PROFILE.md op matrix): nibble extraction widens
+through i32 (no narrow shifts), the -8 offset and per-block scaling happen in
+f32 (no i8 subtract), scales decode from f16 BIT PATTERNS with the proven
+integer-exact _f16_bits_to_f32, and the dot is bf16xbf16->f32 on the MXU. No
+f16 refs anywhere.
+
+Opt-in (Engine prefill_kernel / DLT_PREFILL_KERNEL, bench --prefill-kernel)
+until a hardware A/B lands — same policy as the prologue kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quants import QK, QTensor
+from .pallas_q4 import _f16_bits_to_f32
+
+
+def _mm_kernel(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref, o_ref, *, bn, bkp):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    wp = wp_ref[:]  # (bn, bkp) uint8 packed columns
+    lo = (wp & jnp.uint8(0x0F)).astype(jnp.int32)  # elements [c, c+bkp)
+    hi = wp.astype(jnp.int32) >> 4  # elements [K/2+c, K/2+c+bkp)
+
+    def dequant(q_i32, s_ref):
+        s = _f16_bits_to_f32(s_ref[:])  # (bn, bkp//QK)
+        qf = q_i32.astype(jnp.float32) - 8.0
+        qf = qf.reshape(bn, bkp // QK, QK) * s[:, :, None]
+        return qf.reshape(bn, bkp).astype(jnp.bfloat16)
+
+    w_lo = dequant(lo, slo_ref)
+    w_hi = dequant(hi, shi_ref)
+    acc = jax.lax.dot_general(
+        xlo_ref[:].astype(jnp.bfloat16), w_lo, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (M, bn)
+    acc += jax.lax.dot_general(
+        xhi_ref[:].astype(jnp.bfloat16), w_hi, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[:] += acc
+
+
+_BN = 256  # weight rows per grid step
+
+
+def _pick_bkp(kh: int) -> int | None:
+    """Packed columns per grid step: the largest lane-aligned tile width that
+    divides the half-plane exactly (7B's w2 has kh=5504 -> 128; most dims take
+    512). None = untileable (kh not a multiple of 128)."""
+    for b in (512, 256, 128):
+        if kh % b == 0:
+            return b
+    return None
+
+
+def q4_mm_supported(w: QTensor, m: int) -> bool:
+    """Whether the fused dequant-matmul can run this weight for M activation
+    rows: i4p layout, self-contained pack (groups folded away by
+    _localize_qtensors under TP), half-plane divisible into lane-aligned tiles,
+    and an (M, bn) f32 accumulator that stays tiny."""
+    if w.layout != "i4p" or w.groups != 1 or w.data.ndim != 2:
+        return False
+    kh = w.data.shape[1]  # K/2 packed columns
+    return _pick_bkp(kh) is not None and m <= 512
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q4_matmul(x, wp, scales, *, interpret: bool = False):
+    """x (M, K) -> (M, N) against packed nibbles (N, K/2) + int16 f16-bit scales
+    (N, K/32)."""
+    m, k = x.shape
+    n, kh = wp.shape
+    nb = k // QK
+    assert kh * 2 == k and scales.shape == (n, nb), (x.shape, wp.shape,
+                                                     scales.shape)
+    bkp = _pick_bkp(kh)
+    assert bkp is not None, (kh, "half-plane not tileable; gate with "
+                                 "q4_mm_supported")
+    bn = min(_BN, n)
+    gk = kh // bkp
+    sb = bkp // QK  # scale columns per tile
+    kernel = functools.partial(_mm_kernel, bn=bn, bkp=bkp)
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(n, bn), gk),
+        in_specs=[
+            # two views of x: the tile's low-plane and high-plane K-ranges
+            pl.BlockSpec((m, bkp), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, bkp), lambda i, j: (0, j + gk),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, bkp), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, sb), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, sb), lambda i, j: (i, j + gk),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=interpret,
+    )(x, x, wp, scales, scales)
+
+
+def q4_matmul(x: jax.Array, w: QTensor, *, out_dtype=None,
+              interpret: bool | None = None) -> jax.Array:
+    """Prefill/batched matmul: x (..., K) against an i4p QTensor (N, K) ->
+    (..., N), weights streamed once at 4-bit density."""
+    m_total = 1
+    for d in x.shape[:-1]:
+        m_total *= d
+    if not q4_mm_supported(w, m_total):
+        raise ValueError(
+            f"q4_matmul cannot run this weight (layout={w.layout}, "
+            f"groups={w.groups}, shape={getattr(w.data, 'shape', None)}, "
+            f"M={m_total}); gate with q4_mm_supported")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    y = _q4_matmul(x.reshape(m_total, k), w.data, w.scales, interpret=interpret)
+    return y.reshape(*lead, y.shape[-1]).astype(out_dtype or x.dtype)

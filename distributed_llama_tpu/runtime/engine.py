@@ -85,7 +85,8 @@ class Engine:
                  use_pallas: bool | None = None,
                  compress_collectives: bool = False, batch: int = 1,
                  pod: bool = False, cache_write: str | None = None,
-                 moe_sharding: str = "slice", fused_prologue: bool | None = None):
+                 moe_sharding: str = "slice", fused_prologue: bool | None = None,
+                 prefill_kernel: bool | None = None):
         self.spec = spec
         self.tokenizer = tokenizer
         on_tpu = jax.default_backend() == "tpu"
@@ -142,6 +143,17 @@ class Engine:
             getattr(t, "ftype", None) in (FloatType.Q40, FloatType.Q80)
             for t in params["blocks"].values())
         self.use_pallas = use_pallas and has_quant
+        # fused dequant-matmul for prefill / batched decode
+        # (ops/pallas_q4_mm.py): opt-in (flag or DLT_PREFILL_KERNEL=1) until
+        # the hardware A/B lands — same policy as the prologue kernels
+        if prefill_kernel is None:
+            import os
+
+            prefill_kernel = os.environ.get("DLT_PREFILL_KERNEL", "").lower() in (
+                "1", "true", "yes")
+        self.prefill_kernel = prefill_kernel and self.use_pallas
+        if self.prefill_kernel:
+            self.use_pallas = "all"  # qmatmul's M>1 kernel opt-in
         if self.use_pallas:
             params = prepare_for_pallas(params, self.tp,
                                         moe_sharding=self.moe_sharding,

@@ -55,6 +55,8 @@ CONFIGS = [
     ["--steps", "64", "--window", "2048"],
     ["--prefill", "64", "--steps", "16"],
     ["--prefill", "128", "--steps", "16"],
+    ["--prefill", "64", "--steps", "16", "--prefill-kernel"],
+    ["--prefill", "128", "--steps", "16", "--prefill-kernel"],
     ["--arch", "tinyllama_1_1b", "--steps", "32"],
     ["--arch", "llama3_8b", "--steps", "32"],
     ["--arch", "mixtral_8x7b_l8", "--steps", "16"],
