@@ -327,6 +327,7 @@ def main(argv=None) -> None:
             else None,
             slots=args.batch, tp=args.tp, dp=args.dp, pod=args.pod,
             cache_write=args.cache_write, moe_sharding=args.moe_sharding,
+            fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
             dtype=(None if args.dtype == "auto"
                    else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
             use_pallas=False if args.no_pallas else None,

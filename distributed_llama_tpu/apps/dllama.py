@@ -81,6 +81,14 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                         "rows in one top-level write (avoids XLA TPU's whole-cache "
                         "carry copies; works with --sp too); 'inscan' is the "
                         "per-layer in-place form")
+    p.add_argument("--prologue", action="store_true", default=None,
+                   help="fused rmsnorm+quantize prologue kernels on the decode "
+                        "path (ops/pallas_prologue.py; also DLT_PROLOGUE=1) — "
+                        "opt-in until the hardware A/B lands")
+    p.add_argument("--prefill-kernel", action="store_true", default=None,
+                   help="fused 4-bit dequant-matmul for prefill and batched "
+                        "decode (ops/pallas_q4_mm.py; also DLT_PREFILL_KERNEL=1) "
+                        "— opt-in until the hardware A/B lands")
     p.add_argument("--device-loop", type=int, default=0, metavar="CHUNK",
                    help="decode CHUNK tokens per dispatch with the on-device scan loop "
                         "(runtime/device_loop.py); 0 = per-token host loop")
@@ -143,6 +151,7 @@ def make_engine(args) -> Engine:
         use_pallas=False if args.no_pallas else None,
         compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1,
         cache_write=args.cache_write, moe_sharding=args.moe_sharding,
+        fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
     )
     print(f"⏩ Loaded model in {time.perf_counter() - t0:.1f}s "
           f"(tp={engine.tp}, pallas={engine.use_pallas})")

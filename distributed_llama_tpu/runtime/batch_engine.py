@@ -89,6 +89,13 @@ class BatchEngine:
         from .engine import Engine
 
         assert slots >= 1
+        if engine_kw.get("fused_prologue") and slots > 1:
+            import sys
+
+            print("⚠️  --prologue is inert with batched decode (the prologue "
+                  "kernels take one activation row; forward gates them off for "
+                  "B > 1) — the A/B lever will not engage", file=sys.stderr,
+                  flush=True)
         assert engine_kw.get("sp", 1) in (None, 1), (
             "continuous batching needs per-row cache positions, which the "
             "sequence-sharded (ring) cache does not support")
