@@ -205,13 +205,10 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)  # (B, hk, T, hs)
         v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
         win = window or s
-        # the fused kernel DMAs a (win, hs) K and V block per head into VMEM with no
-        # tiling over the window axis; once the window saturates to a long seq_len
-        # (Engine._window_for returns None at the last bucket) those blocks can
-        # exceed VMEM (~16 MB/core) and the step fails to lower MID-GENERATION.
-        # Route such windows to the XLA deferred path below, which tiles fine.
-        fused_vmem_ok = win * hs * jnp.dtype(kc.dtype).itemsize * 2 <= (8 << 20)
-        if use_pallas and t == 1 and b == 1 and start_pos.ndim == 0 and fused_vmem_ok:
+        # windows past the single-block VMEM budget take the kernel's window-
+        # tiled form (flash-attention carry in scratch, ops/pallas_attention.py)
+        # — long contexts never fall back to XLA slicing mid-generation
+        if use_pallas and t == 1 and b == 1 and start_pos.ndim == 0:
             # fused decode kernel: the cache window is DMA'd straight out of the
             # stacked buffers inside the kernel (ops/pallas_attention.py) — no
             # per-layer dynamic-slice materialization in XLA at all

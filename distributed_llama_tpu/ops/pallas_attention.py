@@ -64,6 +64,61 @@ def _kernel(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref):
     o_ref[0] = out
 
 
+def _kernel_tiled(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, wt, gw):
+    """Window-tiled variant: grid (hk, gw); each step attends one (wt, hs) slice
+    of the window with a flash-attention m/l/acc carry in VMEM scratch, so VMEM
+    holds one tile regardless of the window (long-context decode keeps the fused
+    kernel instead of falling back to the XLA path). The current token's k/v
+    fold in at the last tile."""
+    j = pl.program_id(1)
+    pos = pos_ref[0]
+    q = q_ref[0]  # (g, hs) f32
+    scale = jnp.float32(1.0 / math.sqrt(q.shape[-1]))
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kw = kw_ref[0, 0].astype(jnp.float32)  # (wt, hs)
+    vw = vw_ref[0, 0].astype(jnp.float32)
+    # a trailing partial tile's padded region holds UNSPECIFIED bits; the score
+    # mask alone cannot save acc from 0*NaN, so zero the invalid V rows too
+    row = jax.lax.broadcasted_iota(jnp.int32, (vw.shape[0], 1), 0) + j * wt
+    vw = jnp.where(row < pos, vw, 0.0)
+    s = jax.lax.dot_general(q, kw, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # (g, wt)
+    slot = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * wt
+    s = jnp.where(slot < pos, s, _NEG)  # committed rows only; masks tile padding
+    # (NaN scores from padded K rows are replaced by _NEG here — jnp.where
+    # selects the mask value regardless of NaN)
+    m_new = jnp.maximum(m_ref[:], jnp.max(s, axis=1, keepdims=True))
+    a = jnp.exp(m_ref[:] - m_new)
+    p = jnp.exp(s - m_new)
+    l_ref[:] = l_ref[:] * a + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * a + jax.lax.dot_general(
+        p, vw, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+
+    @pl.when(j == gw - 1)
+    def _finalize():
+        kn = kn_ref[0].astype(jnp.float32)  # (1, hs) current token
+        vn = vn_ref[0].astype(jnp.float32)
+        s_new = jnp.sum(q * kn, axis=-1, keepdims=True) * scale  # (g, 1)
+        m_f = jnp.maximum(m_ref[:], s_new)
+        a_f = jnp.exp(m_ref[:] - m_f)
+        p_new = jnp.exp(s_new - m_f)
+        denom = l_ref[:] * a_f + p_new
+        o_ref[0] = (acc_ref[:] * a_f + p_new * vn) / denom
+
+
+# per-operand VMEM budget for the single-block kernel; larger windows tile
+_FUSED_ONE_BLOCK_LIMIT = 4 << 20
+_WT = 2048  # window slots per tile in the tiled kernel
+
+
 @functools.partial(jax.jit, static_argnames=("window", "interpret"))
 def fused_decode_attention(q, kc, vc, k_new, v_new, layer_idx, pos, *,
                            window: int, interpret: bool | None = None):
@@ -84,21 +139,54 @@ def fused_decode_attention(q, kc, vc, k_new, v_new, layer_idx, pos, *,
     assert b == 1 and hk2 == hk and hs2 == hs, (q.shape, kc.shape)
     assert k_new.shape == (hk, 1, hs), k_new.shape
     win = min(window, s)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # (layer_idx_arr, pos_arr)
-        grid=(hk,),
-        in_specs=[
-            pl.BlockSpec((1, g, hs), lambda h, li, po: (h, 0, 0)),
-            pl.BlockSpec((1, 1, hs), lambda h, li, po: (h, 0, 0)),
-            pl.BlockSpec((1, 1, hs), lambda h, li, po: (h, 0, 0)),
-            pl.BlockSpec((1, 1, win, hs), lambda h, li, po: (li[0], h, 0, 0)),
-            pl.BlockSpec((1, 1, win, hs), lambda h, li, po: (li[0], h, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, g, hs), lambda h, li, po: (h, 0, 0)),
-    )
-    def kernel(li_ref, pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref):
-        # li_ref is consumed by the BlockSpec index_maps only
-        _kernel(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref)
+    one_block = win * hs * jnp.dtype(kc.dtype).itemsize <= _FUSED_ONE_BLOCK_LIMIT
+
+    if one_block:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # (layer_idx_arr, pos_arr)
+            grid=(hk,),
+            in_specs=[
+                pl.BlockSpec((1, g, hs), lambda h, li, po: (h, 0, 0)),
+                pl.BlockSpec((1, 1, hs), lambda h, li, po: (h, 0, 0)),
+                pl.BlockSpec((1, 1, hs), lambda h, li, po: (h, 0, 0)),
+                pl.BlockSpec((1, 1, win, hs), lambda h, li, po: (li[0], h, 0, 0)),
+                pl.BlockSpec((1, 1, win, hs), lambda h, li, po: (li[0], h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, g, hs), lambda h, li, po: (h, 0, 0)),
+        )
+
+        def kernel(li_ref, pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref):
+            # li_ref is consumed by the BlockSpec index_maps only
+            _kernel(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref)
+
+    else:
+        # long-context form: tile the window axis with a flash-attention carry
+        wt = min(_WT, win)
+        gw = pl.cdiv(win, wt)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(hk, gw),
+            in_specs=[
+                pl.BlockSpec((1, g, hs), lambda h, j, li, po: (h, 0, 0)),
+                pl.BlockSpec((1, 1, hs), lambda h, j, li, po: (h, 0, 0)),
+                pl.BlockSpec((1, 1, hs), lambda h, j, li, po: (h, 0, 0)),
+                pl.BlockSpec((1, 1, wt, hs),
+                             lambda h, j, li, po: (li[0], h, j, 0)),
+                pl.BlockSpec((1, 1, wt, hs),
+                             lambda h, j, li, po: (li[0], h, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, g, hs), lambda h, j, li, po: (h, 0, 0)),
+            scratch_shapes=[pltpu.VMEM((g, 1), jnp.float32),
+                            pltpu.VMEM((g, 1), jnp.float32),
+                            pltpu.VMEM((g, hs), jnp.float32)],
+        )
+        body = functools.partial(_kernel_tiled, wt=wt, gw=gw)
+
+        def kernel(li_ref, pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref,
+                   o_ref, m_ref, l_ref, acc_ref):
+            body(pos_ref, q_ref, kn_ref, vn_ref, kw_ref, vw_ref, o_ref,
+                 m_ref, l_ref, acc_ref)
+
 
     return pl.pallas_call(
         kernel,

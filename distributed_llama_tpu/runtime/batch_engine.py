@@ -89,18 +89,20 @@ class BatchEngine:
         from .engine import Engine
 
         assert slots >= 1
-        if engine_kw.get("fused_prologue") and slots > 1:
+        assert engine_kw.get("sp", 1) in (None, 1), (
+            "continuous batching needs per-row cache positions, which the "
+            "sequence-sharded (ring) cache does not support")
+        self.slots_n = slots
+        self._eng = Engine(spec, params, tokenizer, batch=slots, **engine_kw)
+        # check the ENGINE's resolution (kwarg or DLT_PROLOGUE env) — warning on
+        # the kwarg alone would miss the env route the flag help advertises
+        if self._eng.fused_prologue and slots > 1:
             import sys
 
             print("⚠️  --prologue is inert with batched decode (the prologue "
                   "kernels take one activation row; forward gates them off for "
                   "B > 1) — the A/B lever will not engage", file=sys.stderr,
                   flush=True)
-        assert engine_kw.get("sp", 1) in (None, 1), (
-            "continuous batching needs per-row cache positions, which the "
-            "sequence-sharded (ring) cache does not support")
-        self.slots_n = slots
-        self._eng = Engine(spec, params, tokenizer, batch=slots, **engine_kw)
         self.spec = spec
         self.tokenizer = tokenizer
         self._slots = [_Slot(i) for i in range(slots)]

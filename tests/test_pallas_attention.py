@@ -64,3 +64,28 @@ def test_fused_decode_attention_bf16_cache():
     want = _oracle(q.reshape(1, 1, hk * g, hs), kc, vc, k_new, v_new, 0, 7, 16)
     np.testing.assert_allclose(np.asarray(got).reshape(1, 1, -1), np.asarray(want),
                                atol=2e-2, rtol=2e-2)
+
+
+def test_tiled_window_matches_one_block(monkeypatch):
+    """The window-tiled (flash-carry) form must reproduce the single-block
+    kernel exactly on the same inputs — forced by shrinking the one-block VMEM
+    budget so a small window takes the tiled branch (with a tile size that
+    yields several tiles plus a padded tail)."""
+    import distributed_llama_tpu.ops.pallas_attention as pa
+
+    rng = np.random.RandomState(7)
+    L, hk, g, s, hs = 2, 2, 3, 96, 16
+    q = jnp.asarray(rng.randn(hk, g, hs).astype(np.float32))
+    kc = jnp.asarray(rng.randn(L, 1, hk, s, hs).astype(np.float32))
+    vc = jnp.asarray(rng.randn(L, 1, hk, s, hs).astype(np.float32))
+    kn = jnp.asarray(rng.randn(hk, 1, hs).astype(np.float32))
+    vn = jnp.asarray(rng.randn(hk, 1, hs).astype(np.float32))
+
+    want = pa.fused_decode_attention(q, kc, vc, kn, vn, 1, 37, window=96)
+    monkeypatch.setattr(pa, "_FUSED_ONE_BLOCK_LIMIT", 1)
+    monkeypatch.setattr(pa, "_WT", 40)  # 96 -> tiles of 40/40/16(padded)
+    pa.fused_decode_attention._clear_cache()
+    got = pa.fused_decode_attention(q, kc, vc, kn, vn, 1, 37, window=96)
+    pa.fused_decode_attention._clear_cache()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)

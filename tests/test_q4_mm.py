@@ -128,3 +128,27 @@ def test_engine_prefill_kernel_generation_matches():
     got, _ = eng.generate([1, 7, 3, 9, 2], 6,
                           Sampler(spec.vocab_size, temperature=0.0))
     assert got == want
+
+
+def test_batch_engine_with_prefill_kernel_matches():
+    """Batched decode (B=2 slots) engages the dequant-matmul at m=B>1; tokens
+    must match the non-kernel batched engine exactly."""
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=5)
+    prompts = [[1, 7, 23, 5], [1, 9, 2]]
+
+    def run(**kw):
+        be = BatchEngine(spec, params, slots=2, tp=2, use_pallas=True, **kw)
+        try:
+            reqs = [be.submit(list(p), 6, Sampler(spec.vocab_size, temperature=0.0))
+                    for p in prompts]
+            return [r.wait(timeout=180) for r in reqs]
+        finally:
+            be.close()
+
+    want = run()
+    got = run(prefill_kernel=True)
+    assert got == want
