@@ -502,6 +502,8 @@ def main():
             out["prefill_kernel"] = False
         if "fallback_reason" in state:
             out["fallback_reason"] = state["fallback_reason"]
+        if args.profile_dir:
+            out["profiled"] = True
         print(json.dumps(out))
         return
 
@@ -570,6 +572,7 @@ def main():
         "cache_write": state["cache_write"],
         "attn_window": window or spec.seq_len,
         "device_loop": args.device_loop,
+        "steps": args.steps,
         "fused": not args.no_fuse,
         # report the EFFECTIVE prologue state: forward() re-gates it off for
         # non-pallas runs and unsupported dims, and an A/B record claiming a
@@ -579,6 +582,10 @@ def main():
     }
     if "fallback_reason" in state:
         out["fallback_reason"] = state["fallback_reason"]
+    if args.profile_dir:
+        # a profiler-instrumented run is NOT comparable to the clean headline —
+        # mark it so metric-keyed JSONL consumers cannot silently pick it up
+        out["profiled"] = True
     print(json.dumps(out))
 
 

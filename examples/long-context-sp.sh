@@ -1,0 +1,21 @@
+#!/bin/bash
+# Long-context sequence parallelism demo on the 8-device virtual CPU mesh:
+# the KV cache shards over sp=4 (each device holds seq_len/4 positions in the
+# STRIPED deferred layout), ring attention rotates only the live-context window
+# per decode step, and tp=2 shards heads orthogonally. This is the TPU-native
+# answer to the reference's --kv-cache-storage disc out-of-core valve (see
+# README "Long context / memory"); the same command runs unchanged on a real
+# TPU mesh.
+#
+#   bash examples/long-context-sp.sh <model.m> <tokenizer.t> [prompt]
+set -e
+MODEL="$(realpath "${1:?usage: long-context-sp.sh model.m tokenizer.t [prompt]}")"
+TOK="$(realpath "${2:?usage: long-context-sp.sh model.m tokenizer.t [prompt]}")"
+PROMPT="${3:-Once upon a time}"
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+python -m distributed_llama_tpu.apps.dllama generate \
+  --model "$MODEL" --tokenizer "$TOK" \
+  --prompt "$PROMPT" --steps 48 --temperature 0 \
+  --tp 2 --sp 4

@@ -61,6 +61,9 @@ CONFIGS = [
     ["--arch", "llama3_8b", "--steps", "32"],
     ["--arch", "mixtral_8x7b_l8", "--steps", "16"],
     ["--arch", "grok1_l2", "--steps", "16"],
+    # post-deferred profiler trace (VERDICT r4 item 4: where does the residual
+    # non-kernel time go once the carry copies are gone?)
+    ["--steps", "8", "--profile-dir", "perf/r5_trace"],
 ]
 DRILL = ["--steps", "4"]
 
