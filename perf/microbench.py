@@ -10,12 +10,16 @@ Measures, on whatever backend JAX resolves (designed for the single TPU chip):
   3. matvec:q4/q8   — the two decode matvec kernels (ops/pallas_q4.py packed nibbles at
                       0.5625 B/weight vs ops/pallas_q8.py int8 planes at 1.125 B/weight)
                       on the Llama-2-7B hot shapes, reported as achieved GB/s
-  4. attention      — windowed vs full-seq_len cache read cost at 7B head geometry
+  4. prefill_mm     — fused 4-bit dequant-matmul (ops/pallas_q4_mm.py) vs the XLA
+                      dequant+dot path at prefill widths (weight GB/s)
+  5. prologue       — fused rmsnorm+quantize kernels vs their XLA formulation
+  6. attention      — windowed vs full-seq_len cache read cost at 7B head geometry
 
 Each result prints as one JSON line. Timing uses a device->host transfer as the fence:
 on the axon TPU tunnel block_until_ready() returns early (see bench.py).
 
-Usage: python perf/microbench.py [--section dispatch|stream|matvec|attention] [--quick]
+Usage: python perf/microbench.py [--section dispatch|stream|matvec|prefill_mm|
+                                  prologue|attention|collectives] [--quick]
 """
 
 import argparse
@@ -153,6 +157,67 @@ def sec_matvec(reps):
                  ms=round(dt * 1e3, 3), gbps=round(bytes_ / 1e9 / dt, 1))
 
 
+def sec_prefill_mm(reps):
+    """Fused 4-bit dequant-matmul (ops/pallas_q4_mm.py) vs the XLA dequant+dot
+    path on the 7B hot shapes at prefill widths — isolates whether XLA
+    materializes the bf16 operands (the prefill cost model's open question,
+    perf/PROFILE.md) and what the kernel's effective weight GB/s is."""
+    from distributed_llama_tpu.ops.matmul import qmatmul
+    from distributed_llama_tpu.ops.pallas_q4_mm import q4_matmul, q4_mm_supported
+
+    on_tpu = jax.default_backend() == "tpu"
+    shapes = [(4096, 4096), (11008, 4096), (4096, 11008)]
+    for n, k in shapes:
+        w = _rand_q40(min(n, 2048) if not on_tpu else n, k)
+        wl = jax.tree_util.tree_map(jnp.asarray, w.to_i4p_layout())
+        for m in (16, 64, 128):
+            x = jnp.ones((m, k), jnp.bfloat16)
+            bytes_ = wl.data.nbytes + wl.scales.nbytes
+            if q4_mm_supported(wl, m):
+                g = jax.jit(functools.partial(q4_matmul, interpret=not on_tpu))
+                dt = timed(g, x, wl, reps=reps)
+                emit(section="prefill_mm", path="kernel", m=m, n=wl.shape[0],
+                     k=k, ms=round(dt * 1e3, 3),
+                     weight_gbps=round(bytes_ / 1e9 / dt, 1))
+            g = jax.jit(functools.partial(qmatmul, use_pallas=False))
+            dt = timed(g, x, wl, reps=reps)
+            emit(section="prefill_mm", path="xla_dequant", m=m, n=wl.shape[0],
+                 k=k, ms=round(dt * 1e3, 3),
+                 weight_gbps=round(bytes_ / 1e9 / dt, 1))
+
+
+def sec_prologue(reps):
+    """Fused rmsnorm+quantize prologue kernels vs their XLA formulation at the
+    7B activation widths — the per-launch cost these kernels exist to remove."""
+    from distributed_llama_tpu.ops.kernels import rmsnorm
+    from distributed_llama_tpu.ops.pallas_prologue import (quantize_q80_row,
+                                                           rmsnorm_quantize_q80)
+    from distributed_llama_tpu.ops.pallas_q8 import _quantize_row
+
+    on_tpu = jax.default_backend() == "tpu"
+    for k in (4096, 11008):
+        x = jnp.ones((1, 1, k), jnp.bfloat16)
+        wn = jnp.ones((k,), jnp.float32)
+
+        g = jax.jit(functools.partial(rmsnorm_quantize_q80, eps=1e-5,
+                                      interpret=not on_tpu))
+        dt = timed(lambda a, b: g(a, b)[0], x, wn, reps=reps)
+        emit(section="prologue", op="rmsnorm_q80_kernel", k=k,
+             ms=round(dt * 1e3, 4))
+
+        def xla_form(a, b):
+            xb = rmsnorm(a, b, 1e-5)
+            return _quantize_row(xb.reshape(k), k // 32)[0]
+
+        dt = timed(jax.jit(xla_form), x, wn, reps=reps)
+        emit(section="prologue", op="rmsnorm_q80_xla", k=k,
+             ms=round(dt * 1e3, 4))
+
+        gq = jax.jit(functools.partial(quantize_q80_row, interpret=not on_tpu))
+        dt = timed(lambda a: gq(a)[0], x, reps=reps)
+        emit(section="prologue", op="quantize_kernel", k=k, ms=round(dt * 1e3, 4))
+
+
 def sec_attention(reps):
     """Cache read cost: full 2048-window vs 256-window at 7B geometry, per layer."""
     from distributed_llama_tpu.ops.attention import gqa_attention
@@ -210,14 +275,15 @@ def sec_collectives(reps):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--section", default=None,
-                    choices=["dispatch", "stream", "matvec", "attention",
-                             "collectives"])
+                    choices=["dispatch", "stream", "matvec", "prefill_mm",
+                             "prologue", "attention", "collectives"])
     ap.add_argument("--quick", action="store_true")
     args = ap.parse_args()
     reps = 3 if args.quick else 10
     emit(section="meta", backend=jax.default_backend(),
          device=str(jax.devices()[0]))
     secs = {"dispatch": sec_dispatch, "stream": sec_stream, "matvec": sec_matvec,
+            "prefill_mm": sec_prefill_mm, "prologue": sec_prologue,
             "attention": sec_attention, "collectives": sec_collectives}
     for name, fn in secs.items():
         if args.section in (None, name):
