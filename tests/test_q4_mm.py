@@ -152,3 +152,24 @@ def test_batch_engine_with_prefill_kernel_matches():
     want = run()
     got = run(prefill_kernel=True)
     assert got == want
+
+
+def test_pick_bkp_baseline_arch_coverage():
+    """Pin exactly which BASELINE widths take the kernel and which fall back:
+    all single-chip (tp=1) in-widths are tileable — the adaptive width exists
+    because 7B's w2 half-plane (5504) is not a multiple of 512 — while the odd
+    TP-local slices of 11008-class hidden dims (2752 at tp=4, 1376 at tp=8)
+    are KNOWN fallbacks (half-plane not a multiple of 128). A new arch whose
+    hot width lands in the fallback set should move it to the tileable list or
+    widen the ladder."""
+    from distributed_llama_tpu.ops.pallas_q4_mm import _pick_bkp
+
+    # tp=1 in-widths of every BASELINE arch (dim and hidden): all tileable
+    for k in (4096, 11008, 2048, 5632, 14336, 6144, 32768):
+        assert _pick_bkp(k // 2) is not None, k
+    assert _pick_bkp(5504) == 128  # 7B w2, the reason the ladder exists
+    assert _pick_bkp(2048) == 512
+    # known XLA fallbacks: odd TP-local slices of 11008/5632-class hidden dims
+    for k in (2752, 1376, 704, 1408):
+        assert _pick_bkp(k // 2) is None, k
+    assert _pick_bkp(288) is None  # K=576: untileable, gated out
