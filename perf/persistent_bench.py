@@ -89,6 +89,18 @@ def foreign_bench_active() -> bool:
     return time.time() - _last_foreign_active < FOREIGN_GRACE_S
 
 
+def pause_for_foreign(event: str) -> float:
+    """Block while a foreign (driver) bench holds the sentinel; returns the
+    seconds spent paused so callers can exclude it from their own deadlines."""
+    if not foreign_bench_active():
+        return 0.0
+    t0 = time.time()
+    emit(OUT, {"section": "meta", "event": event})
+    while foreign_bench_active():
+        time.sleep(30)
+    return time.time() - t0
+
+
 def emit(path, obj_or_line):
     line = obj_or_line if isinstance(obj_or_line, str) else json.dumps(obj_or_line)
     print(line, flush=True)
@@ -106,6 +118,7 @@ def wait_for_backend() -> bool:
     import jax.numpy as jnp
 
     t0 = time.time()
+    paused = 0.0  # time yielded to a foreign bench; not charged to the budget
     done = threading.Event()
     state = {}
 
@@ -117,9 +130,10 @@ def wait_for_backend() -> bool:
             state["err"] = str(e)[:120]
         done.set()
 
+    paused += pause_for_foreign("probe_paused_for_foreign_bench")
     threading.Thread(target=probe, daemon=True).start()
     beats = 0
-    while time.time() - t0 < MAX_WAIT_MIN * 60:
+    while time.time() - t0 - paused < MAX_WAIT_MIN * 60:
         if done.wait(timeout=60):
             if state.get("ok"):
                 emit(OUT, {"section": "meta", "event": "backend_up",
@@ -137,6 +151,11 @@ def wait_for_backend() -> bool:
             done.clear()
             state.clear()
             time.sleep(20)
+            # do not spawn fresh init attempts while the driver's bench.py is
+            # probing (its sentinel is up): concurrent inits step on each other
+            # in the half-alive mode. The already-stuck thread (dead mode) just
+            # lingers — it never issues new connection attempts.
+            paused += pause_for_foreign("probe_paused_for_foreign_bench")
             threading.Thread(target=probe, daemon=True).start()
         else:
             beats += 1
@@ -245,18 +264,12 @@ def main():
     # the tunnel is warm in THIS process: headline FIRST (publish the handoff
     # file as early as possible), then the rest of the matrix. EVERY config —
     # including the first — yields to a driver bench already in flight.
-    def pause_for_foreign():
-        if foreign_bench_active():
-            emit(OUT, {"section": "meta", "event": "paused_for_foreign_bench"})
-            while foreign_bench_active():
-                time.sleep(30)
-
-    pause_for_foreign()
+    pause_for_foreign("paused_for_foreign_bench")
     res = run_config(HEADLINE)
     publish_latest(res, HEADLINE)
     for argv, env in [(c, None) for c in CONFIGS[1:]] + [
             (DRILL, {"DLT_FORCE_I4P_FAILURE": "1"})]:
-        pause_for_foreign()
+        pause_for_foreign("paused_for_foreign_bench")
         run_config(argv, env=env)
     emit(OUT, {"section": "meta", "event": "matrix_done",
                "time": time.strftime("%H:%M:%S")})
