@@ -78,6 +78,19 @@ SENTINEL = os.path.join(REPO_DIR, "perf", ".driver_bench_active")
 SENTINEL_EXPIRY_S = 1800  # crashed driver's sentinel stops pausing the runner
 BUSY_MARKER = os.path.join(REPO_DIR, "perf", ".warm_runner_busy")  # runner -> driver "mid-config"
 MAX_HANDOFF_AGE_S = 20 * 3600  # a handoff result older than this round is refused
+HANDOFF_PREFER_AGE_S = 2 * 3600  # fresh enough to prefer over waiting out a busy runner
+
+
+def read_handoff():
+    """Parse BENCH_latest.json once; returns (payload, age_s) or (None, None)
+    on a missing or malformed file (timestamps coerced — hand-edited string
+    values must degrade, not crash)."""
+    try:
+        with open(HANDOFF_LATEST) as f:
+            payload = json.load(f)
+        return payload, time.time() - float(payload["captured_unix"])
+    except (OSError, KeyError, ValueError, TypeError):
+        return None, None
 
 LLAMA2_7B = dict(arch_type=ArchType.LLAMA, dim=4096, hidden_dim=11008, n_layers=32,
                  n_heads=32, n_kv_heads=32, vocab_size=32000, seq_len=2048,
@@ -304,17 +317,15 @@ def main():
         # handoff can serve), cap the wait short and report the runner's recent
         # measurement instead of gambling a long wait (or a concurrent probe)
         # against the driver's own watchdog — a killed bench leaves no output.
-        busy_wait = float(os.environ.get("DLT_BUSY_WAIT", 1500))
-        fresh_handoff = False
-        try:
-            with open(HANDOFF_LATEST) as f:
-                fresh_handoff = (time.time()
-                                 - float(json.load(f)["captured_unix"])
-                                 < 2 * 3600)
-        except (OSError, KeyError, ValueError, TypeError):
-            pass
+        busy_env = os.environ.get("DLT_BUSY_WAIT")
+        busy_wait = float(busy_env) if busy_env is not None else 1500.0
+        _, handoff_age = read_handoff()
+        fresh_handoff = (handoff_age is not None
+                         and handoff_age < HANDOFF_PREFER_AGE_S)
         can_serve_from_handoff = fresh_handoff and is_headline
-        if can_serve_from_handoff:
+        if can_serve_from_handoff and busy_env is None:
+            # an EXPLICIT DLT_BUSY_WAIT means the operator wants the live
+            # measurement; only the default wait is capped by a fresh handoff
             busy_wait = min(busy_wait, 120.0)
         deadline = time.time() + busy_wait
         while True:
@@ -347,21 +358,23 @@ def main():
         # exact headline config so a non-headline variant can never silently
         # report the headline's number.
         if is_headline and os.path.exists(HANDOFF_LATEST):
+            # re-read: the runner may have published a NEWER result during the
+            # probe's timeout window
+            payload, age = read_handoff()
             try:
-                with open(HANDOFF_LATEST) as f:
-                    payload = json.load(f)
-                age = time.time() - float(payload["captured_unix"])
+                if payload is None:
+                    raise ValueError("missing or malformed")
                 if age > MAX_HANDOFF_AGE_S:
                     raise ValueError(f"stale: captured {age / 3600:.1f} h ago")
                 out = dict(payload["result"])
                 out["provenance"] = "warm-runner"
                 out["warm_runner_argv"] = payload.get("argv")
-                out["age_s"] = round(time.time() - payload["captured_unix"], 1)
+                out["age_s"] = round(age, 1)
                 out["captured_at"] = payload.get("captured_at")
                 out["probe_failure_at_capture"] = fail[:200]
                 print(json.dumps(out))
                 return
-            except (OSError, KeyError, ValueError, TypeError) as e:
+            except (KeyError, ValueError, TypeError) as e:
                 fail += f" | BENCH_latest.json unusable: {e!r}"
         print(json.dumps({
             "metric": metric_name(args), "value": 0.0, "unit": "tok/s",

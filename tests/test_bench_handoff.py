@@ -97,3 +97,16 @@ def test_no_handoff_file_reports_unreachable():
     assert rc == 2
     assert out["value"] == 0.0
     assert "TPU unreachable" in out["error"]
+
+
+def test_string_timestamp_handoff_still_served(handoff_file):
+    """A hand-edited handoff with captured_unix as a numeric STRING must still
+    be served (coerced), not crash or report 0.0."""
+    payload = {"result": dict(RESULT), "captured_unix": str(time.time() - 600),
+               "argv": "bench.py --steps 32"}
+    with open(LATEST, "w") as f:
+        json.dump(payload, f)
+    rc, out = _run_bench()
+    assert rc == 0
+    assert out["value"] == RESULT["value"]
+    assert 590 < out["age_s"] < 700
