@@ -12,6 +12,12 @@ device contracts block r it can already be sending/receiving block r+1.
 Every device holds the full Q (queries are small; KV is what grows with context), so the
 output is replicated over sp and no final gather is needed. Combines with TP head
 sharding orthogonally: cache is (B, hk/tp, S/sp, hs) on a (dp, sp, tp) mesh.
+
+Two sequence layouts (selected by the cache-write discipline, models/forward.py):
+contiguous (inscan: device i holds positions [i*Sb, (i+1)*Sb)) and STRIPED
+(deferred: device i's slot j holds position j*sp + i), which spreads the live
+context evenly so static window buckets bound each rotation to ceil(window/sp)
+columns — decode ICI/HBM then tracks the live context, not the allocated seq_len.
 """
 
 from __future__ import annotations
