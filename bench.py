@@ -21,6 +21,7 @@ Usage: python bench.py [--small] [--steps N] [--tp N] [--layout i4p|i8]
 """
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -121,23 +122,66 @@ ARCHS = {
 }
 
 
+# jax.random.randint generates uint32 random bits, a 4x-the-final-bytes device
+# transient for narrow dtypes. The round-5 merged matvec groups stack layers AND
+# group members into one tensor (w13 at 7B i8: 32x22016x4096 = 2.9 GB final,
+# 11.6 GB transient), which RESOURCE_EXHAUSTs the chip during synthesis — the
+# r5 matrix's --layout i8 failure in a fresh process. Cap the transient by
+# generating in slices along axis 0 into a donated (in-place) buffer.
+_RAND_TRANSIENT_BUDGET = 1 << 30  # max uint32 bytes per generation call
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnames="axis")
+def _fill_slice(buf, chunk, i, axis=0):
+    return jax.lax.dynamic_update_slice_in_dim(buf, chunk, i, axis=axis)
+
+
+def _randint_chunked(key, shape, lo, hi, dtype):
+    import math
+
+    if 4 * math.prod(shape) <= _RAND_TRANSIENT_BUDGET or len(shape) < 2:
+        return jax.random.randint(key, shape, lo, hi, dtype)
+    row_bytes = 4 * math.prod(shape[1:])
+    if row_bytes > _RAND_TRANSIENT_BUDGET:
+        # one axis-0 slice still blows the budget (MoE (L, E, N, K) stacks):
+        # recurse per slice
+        buf = jnp.zeros(shape, dtype)
+        for i in range(shape[0]):
+            key, sub = jax.random.split(key)
+            chunk = _randint_chunked(sub, shape[1:], lo, hi, dtype)
+            buf = _fill_slice(buf, chunk[None], i)
+            del chunk
+        return buf
+    # maximal slabs under the budget — NOT one dispatch per row (a (131072, d)
+    # wcls would otherwise make 131k tunnel round-trips)
+    rows_per = max(1, _RAND_TRANSIENT_BUDGET // row_bytes)
+    buf = jnp.zeros(shape, dtype)
+    for i in range(0, shape[0], rows_per):
+        key, sub = jax.random.split(key)
+        n = min(rows_per, shape[0] - i)
+        chunk = jax.random.randint(sub, (n, *shape[1:]), lo, hi, dtype)
+        buf = _fill_slice(buf, chunk, i)
+        del chunk
+    return buf
+
+
 def synth_q40(key, shape, layout: str):
     """Random Q40 tensor synthesized on device, already in the kernel's layout."""
     out, in_ = shape[-2], shape[-1]
     lead = shape[:-2]
     k1, k2 = jax.random.split(key)
     if layout == "i4p":
-        data = jax.random.randint(k1, (*lead, out, in_ // 2), 0, 256, jnp.uint8)
+        data = _randint_chunked(k1, (*lead, out, in_ // 2), 0, 256, jnp.uint8)
         scales = jax.lax.bitcast_convert_type(
             (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
              + 0.001).astype(jnp.float16), jnp.int16)  # i4p carries f16 BIT PATTERNS
         return QTensor(FloatType.Q40, data, scales, layout="i4p")
     if layout == "i8":
-        vals = jax.random.randint(k1, (*lead, out, in_), -8, 8, jnp.int8)
+        vals = _randint_chunked(k1, (*lead, out, in_), -8, 8, jnp.int8)
         scales = (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
                   + 0.001)
         return QTensor(FloatType.Q40, vals, scales, layout="i8")
-    packed = jax.random.randint(k1, (*lead, out, in_ // QK, 16), 0, 256, jnp.uint8)
+    packed = _randint_chunked(k1, (*lead, out, in_ // QK, 16), 0, 256, jnp.uint8)
     scales = (jax.random.uniform(k2, (*lead, out, in_ // QK), jnp.float32) * 0.01
               + 0.001).astype(jnp.float16)
     return QTensor(FloatType.Q40, packed, scales)

@@ -108,7 +108,7 @@ def emit(path, obj_or_line):
         f.write(line + "\n")
 
 
-def wait_for_backend() -> bool:
+def wait_for_backend(max_wait_min: float | None = None) -> bool:
     """In the dead mode the fenced op HANGS (never raises), so it must run on a
     watchdog thread: the main thread heartbeats while a single probe thread blocks
     in backend init; when the tunnel recovers, that same blocked call completes and
@@ -133,7 +133,8 @@ def wait_for_backend() -> bool:
     paused += pause_for_foreign("probe_paused_for_foreign_bench")
     threading.Thread(target=probe, daemon=True).start()
     beats = 0
-    while time.time() - t0 - paused < MAX_WAIT_MIN * 60:
+    budget_min = MAX_WAIT_MIN if max_wait_min is None else max_wait_min
+    while time.time() - t0 - paused < budget_min * 60:
         if done.wait(timeout=60):
             if state.get("ok"):
                 emit(OUT, {"section": "meta", "event": "backend_up",
@@ -163,6 +164,42 @@ def wait_for_backend() -> bool:
                 emit(OUT, {"section": "meta", "event": "still_waiting",
                            "waited_s": round(time.time() - t0, 1)})
     return False
+
+
+def purge_device_memory():
+    """Free EVERYTHING on the device between in-process configs. The first r5
+    matrix run proved gc alone is not enough: each bench.main() leaves buffers
+    pinned by jit-cache constants, so by the --layout i8 config (7.4 GB weights)
+    HBM was full, and every later config — including a 4-element probe — died
+    RESOURCE_EXHAUSTED. Each config rebuilds all its arrays, so force-deleting
+    every live array (and dropping the jit caches that pin them) is safe here."""
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+    try:
+        arrays = list(jax.live_arrays())
+    except Exception:
+        arrays = []
+    for a in arrays:
+        try:
+            a.delete()
+        except Exception:
+            pass  # already deleted/donated; keep freeing the rest
+    gc.collect()
+    # NOTE: clear_caches() forces a re-trace on the next run of even an
+    # identical config (the keep-fresh headline). The persistent on-disk
+    # compilation cache makes that a cache load, not a recompile — an
+    # acceptable price for starting every config from empty HBM.
+
+
+def config_failed(result) -> bool:
+    """A config that produced no JSON line, an explicit error, a 0.0 value, or
+    a handoff-fallback payload (bench.py serves the OLD BENCH_latest result
+    with value>0 and no 'error' when its own probe fails — provenance marks
+    it) leaves the backend suspect."""
+    return (result is None or "error" in result or "provenance" in result
+            or not result.get("value", 0) > 0)
 
 
 def run_config(argv, env=None):
@@ -220,9 +257,7 @@ def run_config(argv, env=None):
             os.path.exists(BUSY_MARKER) and os.remove(BUSY_MARKER)
         except OSError:
             pass
-        import gc
-
-        gc.collect()
+        purge_device_memory()
     lines = [l for l in buf.getvalue().splitlines() if l.strip()]
     if not lines:
         emit(OUT, {"section": "error", "argv": " ".join(argv), "error": "no output"})
@@ -240,8 +275,7 @@ def publish_latest(result, argv):
     # never re-publish a result that itself came from the handoff file (bench.py's
     # fallback fires even in-process when the runner's backend dies) — that would
     # recycle a stale number under an ever-fresh timestamp
-    if (not result or result.get("value", 0) <= 0 or "error" in result
-            or "provenance" in result):
+    if config_failed(result):  # single definition of "suspect result"
         return
     payload = {"result": result, "captured_unix": time.time(),
                "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -269,8 +303,17 @@ def main():
     publish_latest(res, HEADLINE)
     for argv, env in [(c, None) for c in CONFIGS[1:]] + [
             (DRILL, {"DLT_FORCE_I4P_FAILURE": "1"})]:
+        if config_failed(res):
+            # the failed config may have wedged the in-process backend (OOM,
+            # tunnel drop). Memory is already purged; verify the backend
+            # answers a fenced op before burning the next config's attempt.
+            emit(OUT, {"section": "meta", "event": "reprobe_after_failure"})
+            if not wait_for_backend():
+                emit(OUT, {"section": "error",
+                           "error": "backend lost mid-matrix; giving up"})
+                sys.exit(1)
         pause_for_foreign("paused_for_foreign_bench")
-        run_config(argv, env=env)
+        res = run_config(argv, env=env)
     emit(OUT, {"section": "meta", "event": "matrix_done",
                "time": time.strftime("%H:%M:%S")})
     # keep-fresh: periodically re-run the headline so the handoff file stays
@@ -281,6 +324,12 @@ def main():
         if foreign_bench_active():
             emit(OUT, {"section": "meta", "event": "skip_refresh_foreign_bench"})
             continue
+        if config_failed(res):
+            emit(OUT, {"section": "meta", "event": "reprobe_after_failure"})
+            # short per-tick budget: the startup MAX_WAIT_MIN (hours) would
+            # block past t_end and make this retry loop unreachable
+            if not wait_for_backend(max_wait_min=REFRESH_MIN):
+                continue  # keep trying on the next refresh tick
         res = run_config(HEADLINE)
         publish_latest(res, HEADLINE)
     emit(OUT, {"section": "meta", "event": "runner_done",

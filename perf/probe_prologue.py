@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Bisect the --prologue Mosaic failure (r5 matrix: tpu_compile_helper crash).
+
+The --prologue flag switches TWO things at once: the fused rmsnorm+quantize
+prologue kernels (ops/pallas_prologue.py) and the inline-Xexp matvec variants
+(pallas_q4/_q8 _matvec_kernel_inline, routed via ops.matmul.qmatmul_q80). The
+ladder's fallback_reason can't say which one crashed the Mosaic remote-compile
+helper, so this probe compiles each piece separately at a 7B-ish decode shape
+and prints one JSON line per piece.
+
+Run serialized with the warm runner: this script holds the driver sentinel
+(perf/.driver_bench_active) so perf/persistent_bench.py pauses while it owns
+the tunnel (concurrent TPU jobs wedge the axon tunnel — perf/PROFILE.md).
+
+    python perf/probe_prologue.py
+"""
+
+import atexit
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bench import SENTINEL  # noqa: E402
+
+with open(SENTINEL, "w") as f:
+    f.write(str(os.getpid()))
+atexit.register(lambda: os.path.exists(SENTINEL) and os.remove(SENTINEL))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from distributed_llama_tpu.quants import QK, FloatType, QTensor  # noqa: E402
+from distributed_llama_tpu.ops import pallas_prologue  # noqa: E402
+from distributed_llama_tpu.ops.pallas_q4 import q4_matvec  # noqa: E402
+from distributed_llama_tpu.ops.matmul import qmatmul_q80  # noqa: E402
+
+N, K = 4096, 4096  # 7B attention-proj shape; the failing config's hot case
+
+
+def _to_jnp(t: QTensor) -> QTensor:
+    return jax.tree_util.tree_map(jnp.asarray, t)
+
+
+def piece(name, fn):
+    t0 = time.time()
+    try:
+        out = fn()
+        np.asarray(jax.tree_util.tree_leaves(out)[0])  # honest fence
+        rec = {"piece": name, "ok": True, "s": round(time.time() - t0, 1)}
+    except Exception as e:
+        rec = {"piece": name, "ok": False, "s": round(time.time() - t0, 1),
+               "error": f"{type(e).__name__}: {e}"[:400]}
+    print(json.dumps(rec), flush=True)
+    return rec["ok"]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((1, K)), jnp.float32)
+    w_norm = jnp.ones((K,), jnp.float32)
+    xq = jnp.ones((1, K), jnp.int8)
+    sx = jnp.ones((1, K // QK), jnp.float32)
+
+    w40 = QTensor.from_float((rng.standard_normal((N, K)) * 0.05).astype(np.float32),
+                             FloatType.Q40)
+    wi4 = _to_jnp(w40.to_i4p_layout())
+    wi8 = _to_jnp(w40.to_i8_layout())
+
+    piece("quantize_q80_row", lambda: pallas_prologue.quantize_q80_row(x))
+    piece("rmsnorm_quantize_q80", lambda: pallas_prologue.rmsnorm_quantize_q80(
+        x, w_norm, 1e-5))
+    piece("q4_matvec_inline", lambda: q4_matvec(x, wi4, inline_xexp=True))
+    piece("q8_inline_via_qmatmul", lambda: qmatmul_q80(xq, sx, wi8))
+    piece("q4_inline_via_qmatmul", lambda: qmatmul_q80(xq, sx, wi4))
+    # the proven non-inline baseline, as a tunnel-health control
+    piece("q4_matvec_control", lambda: q4_matvec(x, wi4, inline_xexp=False))
+
+
+if __name__ == "__main__":
+    main()
