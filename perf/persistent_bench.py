@@ -27,7 +27,7 @@ import json
 import os
 import sys
 import time
-from contextlib import redirect_stdout
+from contextlib import contextmanager, redirect_stdout
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -103,7 +103,9 @@ def pause_for_foreign(event: str) -> float:
 
 def emit(path, obj_or_line):
     line = obj_or_line if isinstance(obj_or_line, str) else json.dumps(obj_or_line)
-    print(line, flush=True)
+    # the REAL stdout: emit fires from inside run_inprocess's redirect_stdout
+    # (via _LineTee), where print() to sys.stdout would recurse into the tee
+    print(line, file=sys.__stdout__, flush=True)
     with open(path, "a") as f:
         f.write(line + "\n")
 
@@ -202,17 +204,12 @@ def config_failed(result) -> bool:
             or not result.get("value", 0) > 0)
 
 
-def run_config(argv, env=None):
-    """Run one bench.py invocation in-process; returns the parsed result dict
-    (or None on failure). The cmd marker is emitted BEFORE the run so a wedge or
-    exception still leaves the attempt attributable in the JSONL stream."""
-    import bench
-
-    emit(OUT, {"section": "cmd", "argv": "bench.py " + " ".join(argv)})
-    # two-way handshake: a driver bench.py that starts while this config runs
-    # waits for the busy marker to clear instead of probing into a busy tunnel.
-    # Refreshed every 5 min so a >30-min config isn't mistaken for a crashed
-    # runner by bench.py's staleness check.
+@contextmanager
+def busy_marker():
+    """Two-way handshake: a driver bench.py that starts while an in-process job
+    runs waits for the busy marker to clear instead of probing into a busy
+    tunnel. Refreshed every 5 min so a >30-min job isn't mistaken for a crashed
+    runner by bench.py's staleness check."""
     import threading
 
     busy_stop = threading.Event()
@@ -227,6 +224,22 @@ def run_config(argv, env=None):
             busy_stop.wait(300)
 
     threading.Thread(target=_busy_keepalive, daemon=True).start()
+    try:
+        yield
+    finally:
+        busy_stop.set()
+        try:
+            os.path.exists(BUSY_MARKER) and os.remove(BUSY_MARKER)
+        except OSError:
+            pass
+
+
+def run_inprocess(label, argv, call, env=None, emit_all=False):
+    """Run one in-process job with the busy handshake, env swap, stdout capture
+    and post-run device purge. Returns the captured non-empty stdout lines (or
+    None on failure). The cmd marker is emitted BEFORE the run so a wedge or
+    exception still leaves the attempt attributable in the JSONL stream."""
+    emit(OUT, {"section": "cmd", "argv": label + " " + " ".join(argv)})
     old_argv, old_env = sys.argv, {}
     for k, v in (env or {}).items():
         old_env[k] = os.environ.get(k)
@@ -234,13 +247,42 @@ def run_config(argv, env=None):
     # in-process runs are the runner's own, not a foreign job
     old_env.setdefault("DLT_WARM_RUNNER", os.environ.get("DLT_WARM_RUNNER"))
     os.environ["DLT_WARM_RUNNER"] = "1"
-    sys.argv = ["bench.py"] + argv
-    buf = io.StringIO()
+    sys.argv = [label] + argv
+
+    class _LineTee(io.TextIOBase):
+        """Captures lines AND (for emit_all jobs) appends each to the results
+        file as it lands, so a mid-job wedge or kill still leaves every
+        completed line on disk (the runner's append-as-it-lands contract)."""
+
+        def __init__(self):
+            self.lines, self._cur = [], ""
+
+        def write(self, text):
+            self._cur += text
+            while "\n" in self._cur:
+                line, self._cur = self._cur.split("\n", 1)
+                self._emit_line(line)
+            return len(text)
+
+        def _emit_line(self, line):
+            if line.strip():
+                self.lines.append(line)
+                if emit_all:
+                    emit(OUT, line)
+
+        def close_tail(self):
+            """Promote a final line with no trailing newline (the old
+            splitlines() contract)."""
+            self._emit_line(self._cur)
+            self._cur = ""
+
+    buf = _LineTee()
     try:
-        with redirect_stdout(buf):
-            bench.main()
+        with busy_marker(), redirect_stdout(buf):
+            call()
+        buf.close_tail()
     except SystemExit:
-        pass
+        buf.close_tail()
     except Exception as e:
         emit(OUT, {"section": "error", "argv": " ".join(argv),
                    "error": f"{type(e).__name__}: {e}"[:300]})
@@ -252,15 +294,19 @@ def run_config(argv, env=None):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-        busy_stop.set()
-        try:
-            os.path.exists(BUSY_MARKER) and os.remove(BUSY_MARKER)
-        except OSError:
-            pass
         purge_device_memory()
-    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
-    if not lines:
+    if not buf.lines:
         emit(OUT, {"section": "error", "argv": " ".join(argv), "error": "no output"})
+        return None
+    return buf.lines
+
+
+def run_config(argv, env=None):
+    """One bench.py invocation in-process; returns the parsed result dict."""
+    import bench
+
+    lines = run_inprocess("bench.py", argv, bench.main, env=env)
+    if lines is None:
         return None
     emit(OUT, lines[-1])
     try:
@@ -301,19 +347,45 @@ def main():
     pause_for_foreign("paused_for_foreign_bench")
     res = run_config(HEADLINE)
     publish_latest(res, HEADLINE)
-    for argv, env in [(c, None) for c in CONFIGS[1:]] + [
-            (DRILL, {"DLT_FORCE_I4P_FAILURE": "1"})]:
-        if config_failed(res):
-            # the failed config may have wedged the in-process backend (OOM,
+    suspect = config_failed(res)
+    # one job list, one copy of the serialize/reprobe discipline: the bench
+    # matrix, the forced-failure drill, then the extras — the prologue-crash
+    # bisect (which kernel kills the Mosaic remote compile?) and the microbench
+    # sections the bench.py-only matrix never captured (raw-read stream probes
+    # etc. — PROFILE "pending hardware items").
+    jobs = [("bench.py", c, None) for c in CONFIGS[1:]]
+    jobs.append(("bench.py", DRILL, {"DLT_FORCE_I4P_FAILURE": "1"}))
+    jobs.append(("probe_prologue.py", [], None))
+    jobs.extend(("microbench.py", ["--section", sec, "--quick"], None)
+                for sec in ("dispatch", "stream", "matvec", "prefill_mm",
+                            "prologue", "attention"))
+    for label, argv, env in jobs:
+        if suspect:
+            # the failed job may have wedged the in-process backend (OOM,
             # tunnel drop). Memory is already purged; verify the backend
-            # answers a fenced op before burning the next config's attempt.
+            # answers a fenced op before burning the next job's attempt.
             emit(OUT, {"section": "meta", "event": "reprobe_after_failure"})
             if not wait_for_backend():
                 emit(OUT, {"section": "error",
                            "error": "backend lost mid-matrix; giving up"})
                 sys.exit(1)
         pause_for_foreign("paused_for_foreign_bench")
-        res = run_config(argv, env=env)
+        if label == "bench.py":
+            res = run_config(argv, env=env)
+            suspect = config_failed(res)
+        else:
+            import importlib
+
+            try:
+                mod = importlib.import_module(label[:-3])
+            except Exception as e:
+                # an import failure is a code problem, not a wedged backend:
+                # record it and move on without a reprobe
+                emit(OUT, {"section": "error", "argv": label,
+                           "error": f"import: {type(e).__name__}: {e}"[:300]})
+                continue
+            suspect = run_inprocess(label, argv, mod.main,
+                                    emit_all=True) is None
     emit(OUT, {"section": "meta", "event": "matrix_done",
                "time": time.strftime("%H:%M:%S")})
     # keep-fresh: periodically re-run the headline so the handoff file stays
@@ -324,7 +396,7 @@ def main():
         if foreign_bench_active():
             emit(OUT, {"section": "meta", "event": "skip_refresh_foreign_bench"})
             continue
-        if config_failed(res):
+        if suspect:
             emit(OUT, {"section": "meta", "event": "reprobe_after_failure"})
             # short per-tick budget: the startup MAX_WAIT_MIN (hours) would
             # block past t_end and make this retry loop unreachable
@@ -332,6 +404,7 @@ def main():
                 continue  # keep trying on the next refresh tick
         res = run_config(HEADLINE)
         publish_latest(res, HEADLINE)
+        suspect = config_failed(res)
     emit(OUT, {"section": "meta", "event": "runner_done",
                "time": time.strftime("%H:%M:%S")})
 

@@ -15,7 +15,6 @@ the tunnel (concurrent TPU jobs wedge the axon tunnel — perf/PROFILE.md).
     python perf/probe_prologue.py
 """
 
-import atexit
 import json
 import os
 import sys
@@ -23,11 +22,18 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from bench import SENTINEL  # noqa: E402
+if __name__ == "__main__":
+    # standalone: announce as a foreign bench BEFORE the heavy jax/package
+    # imports so the warm runner pauses during the whole import+init window
+    # (in-process callers — perf/persistent_bench.py — serialize themselves
+    # and import main() directly, never taking the sentinel)
+    import atexit
 
-with open(SENTINEL, "w") as f:
-    f.write(str(os.getpid()))
-atexit.register(lambda: os.path.exists(SENTINEL) and os.remove(SENTINEL))
+    from bench import SENTINEL
+
+    with open(SENTINEL, "w") as f:
+        f.write(str(os.getpid()))
+    atexit.register(lambda: os.path.exists(SENTINEL) and os.remove(SENTINEL))
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
