@@ -239,7 +239,7 @@ def run_inprocess(label, argv, call, env=None, emit_all=False):
     and post-run device purge. Returns the captured non-empty stdout lines (or
     None on failure). The cmd marker is emitted BEFORE the run so a wedge or
     exception still leaves the attempt attributable in the JSONL stream."""
-    emit(OUT, {"section": "cmd", "argv": label + " " + " ".join(argv)})
+    emit(OUT, {"section": "cmd", "argv": _job_key(label, argv, env)})
     old_argv, old_env = sys.argv, {}
     for k, v in (env or {}).items():
         old_env[k] = os.environ.get(k)
@@ -315,6 +315,38 @@ def run_config(argv, env=None):
         return None
 
 
+def _job_key(label, argv, env=None):
+    key = label + " " + " ".join(argv)
+    if env:
+        key += " [env:" + " ".join(f"{k}={v}" for k, v in sorted(env.items())) + "]"
+    return key
+
+
+def mark_job_done(label, argv, env=None):
+    """Completed-job marker consumed by completed_jobs() after a supervisor
+    restart (a dead-mode hang inside a config can only be cleared by killing
+    the process — perf/runner_supervisor.sh — and the fresh runner must not
+    redo the configs that already landed)."""
+    emit(OUT, {"section": "meta", "event": "job_done",
+               "argv": _job_key(label, argv, env)})
+
+
+def completed_jobs() -> set:
+    done = set()
+    try:
+        with open(OUT) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("event") == "job_done":
+                    done.add(rec.get("argv"))
+    except OSError:
+        pass
+    return done
+
+
 def publish_latest(result, argv):
     """Atomic handoff write: bench.py falls back to this file when its own
     backend probe fails at driver-capture time."""
@@ -344,6 +376,10 @@ def main():
     # the tunnel is warm in THIS process: headline FIRST (publish the handoff
     # file as early as possible), then the rest of the matrix. EVERY config —
     # including the first — yields to a driver bench already in flight.
+    done_before = completed_jobs()
+    if done_before:
+        emit(OUT, {"section": "meta", "event": "resuming",
+                   "already_done": len(done_before)})
     pause_for_foreign("paused_for_foreign_bench")
     res = run_config(HEADLINE)
     publish_latest(res, HEADLINE)
@@ -360,6 +396,8 @@ def main():
                 for sec in ("dispatch", "stream", "matvec", "prefill_mm",
                             "prologue", "attention"))
     for label, argv, env in jobs:
+        if _job_key(label, argv, env) in done_before:
+            continue
         if suspect:
             # the failed job may have wedged the in-process backend (OOM,
             # tunnel drop). Memory is already purged; verify the backend
@@ -373,6 +411,11 @@ def main():
         if label == "bench.py":
             res = run_config(argv, env=env)
             suspect = config_failed(res)
+            # the forced-failure DRILL is done once it RAN — its whole point
+            # is recording the degrade, so even an error record completes it
+            # (otherwise every supervisor restart would re-run and re-flag it)
+            if not suspect or env:
+                mark_job_done(label, argv, env)
         else:
             import importlib
 
@@ -386,6 +429,8 @@ def main():
                 continue
             suspect = run_inprocess(label, argv, mod.main,
                                     emit_all=True) is None
+            if not suspect:
+                mark_job_done(label, argv, env)
     emit(OUT, {"section": "meta", "event": "matrix_done",
                "time": time.strftime("%H:%M:%S")})
     # keep-fresh: periodically re-run the headline so the handoff file stays

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Supervises perf/persistent_bench.py against the axon tunnel's dead mode.
+#
+# A hang can strike INSIDE a config (a blocked XLA call during compile/synth —
+# observed 01:32 UTC on a fresh i8 bench): no in-process watchdog can interrupt
+# it, so the only recovery is killing the process. This loop restarts the
+# runner whenever (a) it exits nonzero, or (b) the results file stops growing
+# for STALL_MIN minutes mid-job (wait_for_backend heartbeats every 10 min, so
+# a healthy wait never trips this). The restarted runner skips configs that
+# already landed (job_done markers — persistent_bench.completed_jobs).
+#
+#   bash perf/runner_supervisor.sh [outfile] [stall_minutes]
+set -u
+OUT="${1:-perf/r5_hw_results.jsonl}"
+STALL_MIN="${2:-45}"
+cd "$(dirname "$0")/.."
+while true; do
+    python perf/persistent_bench.py "$OUT" 600 &
+    pid=$!
+    while kill -0 "$pid" 2>/dev/null; do
+        sleep 60
+        mtime=$(stat -c %Y "$OUT" 2>/dev/null || echo 0)
+        age=$(( $(date +%s) - mtime ))
+        if [ "$age" -gt $((STALL_MIN * 60)) ]; then
+            echo "{\"section\": \"meta\", \"event\": \"supervisor_restart\", \"stalled_s\": $age}" >> "$OUT"
+            kill -9 "$pid" 2>/dev/null
+            sleep 5
+            break
+        fi
+    done
+    wait "$pid" 2>/dev/null
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+        break  # runner_done: clean exit after the keep-fresh window
+    fi
+    sleep 30
+done
