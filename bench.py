@@ -71,7 +71,11 @@ else:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
-HANDOFF_LATEST = os.path.join(REPO_DIR, "BENCH_latest.json")  # runner -> driver result
+# runner -> driver result. DLT_HANDOFF_PATH overrides so tests exercise the
+# protocol against a scratch file instead of clobbering (and deleting!) a real
+# runner-published hardware result — which a test teardown did on 2026-07-31.
+HANDOFF_LATEST = (os.environ.get("DLT_HANDOFF_PATH")
+                  or os.path.join(REPO_DIR, "BENCH_latest.json"))
 # driver -> runner "pause"; the literal relative path is mirrored in
 # perf/_bench_lib.sh's touch_sentinel (shell can't import this constant without
 # paying a jax import) — keep the two in sync

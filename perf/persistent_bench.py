@@ -96,8 +96,14 @@ def pause_for_foreign(event: str) -> float:
         return 0.0
     t0 = time.time()
     emit(OUT, {"section": "meta", "event": event})
+    beats = 0
     while foreign_bench_active():
         time.sleep(30)
+        beats += 1
+        if beats % 20 == 0:  # ~10 min: keep the supervisor's stall
+            # detector from killing a runner that is correctly yielding
+            emit(OUT, {"section": "meta", "event": "still_paused",
+                       "paused_s": round(time.time() - t0, 1)})
     return time.time() - t0
 
 
@@ -332,6 +338,9 @@ def mark_job_done(label, argv, env=None):
 
 
 def completed_jobs() -> set:
+    """job_done markers since the last COMPLETED matrix: a matrix_done event
+    clears the set, so re-running the supervisor after a finished round redoes
+    every config (fresh numbers) while a mid-matrix restart resumes."""
     done = set()
     try:
         with open(OUT) as f:
@@ -340,7 +349,11 @@ def completed_jobs() -> set:
                     rec = json.loads(line)
                 except ValueError:
                     continue
-                if isinstance(rec, dict) and rec.get("event") == "job_done":
+                if not isinstance(rec, dict):
+                    continue
+                if rec.get("event") == "matrix_done":
+                    done.clear()
+                elif rec.get("event") == "job_done":
                     done.add(rec.get("argv"))
     except OSError:
         pass
@@ -389,13 +402,13 @@ def main():
     # bisect (which kernel kills the Mosaic remote compile?) and the microbench
     # sections the bench.py-only matrix never captured (raw-read stream probes
     # etc. — PROFILE "pending hardware items").
-    jobs = [("bench.py", c, None) for c in CONFIGS[1:]]
-    jobs.append(("bench.py", DRILL, {"DLT_FORCE_I4P_FAILURE": "1"}))
-    jobs.append(("probe_prologue.py", [], None))
-    jobs.extend(("microbench.py", ["--section", sec, "--quick"], None)
+    jobs = [("bench.py", c, None, False) for c in CONFIGS[1:]]
+    jobs.append(("bench.py", DRILL, {"DLT_FORCE_I4P_FAILURE": "1"}, True))
+    jobs.append(("probe_prologue.py", [], None, False))
+    jobs.extend(("microbench.py", ["--section", sec, "--quick"], None, False)
                 for sec in ("dispatch", "stream", "matvec", "prefill_mm",
                             "prologue", "attention"))
-    for label, argv, env in jobs:
+    for label, argv, env, is_drill in jobs:
         if _job_key(label, argv, env) in done_before:
             continue
         if suspect:
@@ -414,7 +427,7 @@ def main():
             # the forced-failure DRILL is done once it RAN — its whole point
             # is recording the degrade, so even an error record completes it
             # (otherwise every supervisor restart would re-run and re-flag it)
-            if not suspect or env:
+            if not suspect or is_drill:
                 mark_job_done(label, argv, env)
         else:
             import importlib

@@ -14,12 +14,15 @@ set -u
 OUT="${1:-perf/r5_hw_results.jsonl}"
 STALL_MIN="${2:-45}"
 cd "$(dirname "$0")/.."
+pid=""
+trap '[ -n "$pid" ] && kill "$pid" 2>/dev/null' EXIT  # no orphaned runners
 while true; do
     python perf/persistent_bench.py "$OUT" 600 &
     pid=$!
     while kill -0 "$pid" 2>/dev/null; do
         sleep 60
-        mtime=$(stat -c %Y "$OUT" 2>/dev/null || echo 0)
+        # a missing file (runner still importing) counts as fresh, not stalled
+        mtime=$(stat -c %Y "$OUT" 2>/dev/null || date +%s)
         age=$(( $(date +%s) - mtime ))
         if [ "$age" -gt $((STALL_MIN * 60)) ]; then
             echo "{\"section\": \"meta\", \"event\": \"supervisor_restart\", \"stalled_s\": $age}" >> "$OUT"

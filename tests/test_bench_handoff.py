@@ -22,7 +22,14 @@ import time
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LATEST = os.path.join(REPO, "BENCH_latest.json")
+# every bench subprocess gets DLT_HANDOFF_PATH pointing here: the protocol is
+# exercised against a scratch file, never the repo-root BENCH_latest.json (a
+# real runner-published hardware result lives there mid-round; an earlier
+# version of this suite deleted it in teardown)
+import tempfile
+
+_SCRATCH = tempfile.mkdtemp(prefix="dlt_handoff_test_")
+LATEST = os.path.join(_SCRATCH, "BENCH_latest.json")
 
 RESULT = {"metric": "llama2_7b_q40_decode_tok_s", "value": 32.35,
           "unit": "tok/s", "vs_baseline": 3.293, "layout": "i4p",
@@ -37,6 +44,7 @@ def _run_bench(extra_args=(), extra_env=None):
     env["PYTHONPATH"] = REPO
     env["JAX_PLATFORMS"] = "tpu"
     env["DLT_PROBE_TIMEOUT"] = "30"
+    env["DLT_HANDOFF_PATH"] = LATEST
     env.update(extra_env or {})
     p = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--steps", "4",
@@ -101,7 +109,9 @@ def test_no_handoff_file_reports_unreachable():
 
 def test_string_timestamp_handoff_still_served(handoff_file):
     """A hand-edited handoff with captured_unix as a numeric STRING must still
-    be served (coerced), not crash or report 0.0."""
+    be served (coerced), not crash or report 0.0. (Takes handoff_file purely
+    for its teardown: the custom payload below must not leak into
+    test_no_handoff_file_reports_unreachable under test reordering.)"""
     payload = {"result": dict(RESULT), "captured_unix": str(time.time() - 600),
                "argv": "bench.py --steps 32"}
     with open(LATEST, "w") as f:
