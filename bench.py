@@ -76,6 +76,19 @@ else:
 # runner-published hardware result — which a test teardown did on 2026-07-31.
 HANDOFF_LATEST = (os.environ.get("DLT_HANDOFF_PATH")
                   or os.path.join(REPO_DIR, "BENCH_latest.json"))
+# Git-TRACKED mirror of the handoff: the 03:15 UTC container restart wiped
+# every gitignored file including BENCH_latest.json, losing the published
+# window-2 result from the only process that had one. The runner publishes to
+# both and commits the mirror, so a restart (or a dead tunnel at driver-capture
+# time) can no longer erase the round's hardware evidence. Tests point
+# DLT_HANDOFF_PATH at a scratch file, which also disables the mirror.
+_tracked_env = os.environ.get("DLT_HANDOFF_TRACKED_PATH")
+if _tracked_env is not None:
+    HANDOFF_TRACKED = _tracked_env or None  # "" disables the mirror (tests)
+else:
+    # independent of DLT_HANDOFF_PATH: relocating the primary handoff must not
+    # silently turn the restart defense off
+    HANDOFF_TRACKED = os.path.join(REPO_DIR, "perf", "BENCH_handoff.json")
 # driver -> runner "pause"; the literal relative path is mirrored in
 # perf/_bench_lib.sh's touch_sentinel (shell can't import this constant without
 # paying a jax import) — keep the two in sync
@@ -87,15 +100,26 @@ HANDOFF_PREFER_AGE_S = 2 * 3600  # fresh enough to prefer over waiting out a bus
 
 
 def read_handoff():
-    """Parse BENCH_latest.json once; returns (payload, age_s) or (None, None)
-    on a missing or malformed file (timestamps coerced — hand-edited string
-    values must degrade, not crash)."""
-    try:
-        with open(HANDOFF_LATEST) as f:
-            payload = json.load(f)
-        return payload, time.time() - float(payload["captured_unix"])
-    except (OSError, KeyError, ValueError, TypeError):
-        return None, None
+    """Parse the freshest readable handoff (BENCH_latest.json, then the tracked
+    mirror); returns (payload, age_s) or (None, None) when neither exists or
+    parses (timestamps coerced — hand-edited string values must degrade, not
+    crash)."""
+    best = (None, None)
+    for path in (HANDOFF_LATEST, HANDOFF_TRACKED):
+        if not path:
+            continue
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            age = time.time() - float(payload["captured_unix"])
+        except (OSError, KeyError, ValueError, TypeError):
+            continue
+        if age < -3600:
+            continue  # far-future stamp: corrupt/hand-edited, never serve it
+        age = max(age, 0.0)  # modest clock skew must not beat every real file
+        if best[1] is None or age < best[1]:
+            best = (payload, age)
+    return best
 
 LLAMA2_7B = dict(arch_type=ArchType.LLAMA, dim=4096, hidden_dim=11008, n_layers=32,
                  n_heads=32, n_kv_heads=32, vocab_size=32000, seq_len=2048,
@@ -405,7 +429,7 @@ def main():
         # number (with explicit provenance) instead of value 0.0. Gated to the
         # exact headline config so a non-headline variant can never silently
         # report the headline's number.
-        if is_headline and os.path.exists(HANDOFF_LATEST):
+        if is_headline:
             # re-read: the runner may have published a NEWER result during the
             # probe's timeout window
             payload, age = read_handoff()

@@ -35,24 +35,27 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 from bench import (  # noqa: E402  — single source of truth for the protocol
-    BUSY_MARKER, HANDOFF_LATEST as BENCH_LATEST, SENTINEL as ACTIVE,
-    SENTINEL_EXPIRY_S)
+    BUSY_MARKER, HANDOFF_LATEST as BENCH_LATEST, HANDOFF_TRACKED,
+    SENTINEL as ACTIVE, SENTINEL_EXPIRY_S)
 
-OUT = sys.argv[1] if len(sys.argv) > 1 else "perf/r5_hw_results.jsonl"
-MAX_WAIT_MIN = float(sys.argv[2]) if len(sys.argv) > 2 else 600.0
+# argv belongs to this script only when it IS the script — under pytest (which
+# imports this module for _git_commit_path) argv holds pytest's own arguments
+_IS_SCRIPT = os.path.basename(sys.argv[0] or "").startswith("persistent_bench")
+OUT = (sys.argv[1] if _IS_SCRIPT and len(sys.argv) > 1
+       else "perf/r5_hw_results.jsonl")
+MAX_WAIT_MIN = float(sys.argv[2]) if _IS_SCRIPT and len(sys.argv) > 2 else 600.0
 REFRESH_MIN = 20.0
 KEEP_FRESH_HOURS = 14.0
 
 HEADLINE = ["--steps", "32"]
+# Ordered by next-window value: the 01:09 window closed after ~8 usable
+# minutes, so the never-yet-measured judge deliverables (prefill tok/s —
+# VERDICT r4 item 5; per-arch sweep — item 6) come before the comparison
+# levers that already have one window of data (no-fuse/prologue/inscan) and
+# the lower-stakes A/Bs (device-loop, window, i8). Resume markers key on argv,
+# not position, so reordering composes with a mid-matrix restart.
 CONFIGS = [
     HEADLINE,
-    ["--steps", "32", "--no-fuse"],
-    ["--steps", "32", "--prologue"],
-    ["--steps", "32", "--cache-write", "inscan"],
-    ["--steps", "32", "--layout", "i8"],
-    ["--steps", "32", "--device-loop", "8"],
-    ["--steps", "64", "--device-loop", "32"],
-    ["--steps", "64", "--window", "2048"],
     ["--prefill", "64", "--steps", "16"],
     ["--prefill", "128", "--steps", "16"],
     ["--prefill", "64", "--steps", "16", "--prefill-kernel"],
@@ -61,6 +64,13 @@ CONFIGS = [
     ["--arch", "llama3_8b", "--steps", "32"],
     ["--arch", "mixtral_8x7b_l8", "--steps", "16"],
     ["--arch", "grok1_l2", "--steps", "16"],
+    ["--steps", "32", "--no-fuse"],
+    ["--steps", "32", "--prologue"],
+    ["--steps", "32", "--cache-write", "inscan"],
+    ["--steps", "32", "--layout", "i8"],
+    ["--steps", "32", "--device-loop", "8"],
+    ["--steps", "64", "--device-loop", "32"],
+    ["--steps", "64", "--window", "2048"],
     # post-deferred profiler trace (VERDICT r4 item 4: where does the residual
     # non-kernel time go once the carry copies are gone?)
     ["--steps", "8", "--profile-dir", "perf/r5_trace"],
@@ -371,12 +381,70 @@ def publish_latest(result, argv):
     payload = {"result": result, "captured_unix": time.time(),
                "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                "argv": "bench.py " + " ".join(argv)}
-    tmp = BENCH_LATEST + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-    os.replace(tmp, BENCH_LATEST)
+    for path in (BENCH_LATEST, HANDOFF_TRACKED):
+        if not path:
+            continue  # tests run with DLT_HANDOFF_PATH: no tracked mirror
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    commit_tracked_handoff()
     emit(OUT, {"section": "meta", "event": "published_latest",
                "value": result.get("value")})
+
+
+def commit_tracked_handoff():
+    """Commit ONLY the tracked mirror (pathspec commit: staged-but-uncommitted
+    builder work is untouched). The 2026-07-31 03:15 container restart proved
+    gitignored files don't survive restarts — an uncommitted handoff is one
+    restart away from being the round-4 `value: 0.0` failure again. Best-effort:
+    a concurrent builder commit holding index.lock just means the next publish
+    retries."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not HANDOFF_TRACKED or not HANDOFF_TRACKED.startswith(repo + os.sep):
+        return  # test scratch paths live outside the repo: nothing to commit
+    try:
+        ok, detail = _git_commit_path(repo, HANDOFF_TRACKED)
+        if not ok:
+            # a dead defense must be visible in the results stream, not
+            # discovered after the next restart has destroyed the evidence
+            emit(OUT, {"section": "meta", "event": "handoff_commit_failed",
+                       "detail": detail[:200]})
+    except Exception as e:
+        try:  # never let git plumbing take down the runner
+            emit(OUT, {"section": "meta", "event": "handoff_commit_failed",
+                       "detail": f"{type(e).__name__}: {e}"[:200]})
+        except Exception:
+            pass
+
+
+def _git_commit_path(repo, path):
+    """Commit ONE path's working-tree state; returns (ok, detail). The file
+    starts life UNTRACKED, and a pathspec commit rejects untracked files — it
+    must be `git add`ed first. Unchanged-since-last-commit counts as ok."""
+    import subprocess
+
+    diff = subprocess.run(
+        ["git", "-C", repo, "status", "--porcelain", "--", path],
+        capture_output=True, text=True, timeout=30)
+    if not diff.stdout.strip():
+        return True, "unchanged"
+    add = subprocess.run(["git", "-C", repo, "add", "--", path],
+                         capture_output=True, text=True, timeout=30)
+    commit_cmd = ["git", "-C", repo, "commit", "-m",
+                  "Publish warm-runner bench handoff", "--", path]
+    com = subprocess.run(commit_cmd, capture_output=True, text=True, timeout=30)
+    if com.returncode and "Author identity unknown" in com.stderr:
+        # no user.name/email in this environment: fall back to an explicit
+        # identity rather than losing the handoff commit
+        com = subprocess.run(
+            ["git", "-c", "user.name=dlt-runner",
+             "-c", "user.email=runner@localhost"] + commit_cmd[1:],
+            capture_output=True, text=True, timeout=30)
+    if add.returncode or com.returncode:
+        return False, f"rc={add.returncode}/{com.returncode}: " + (
+            add.stderr + com.stderr).strip()
+    return True, "committed"
 
 
 def main():

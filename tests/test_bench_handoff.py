@@ -45,6 +45,7 @@ def _run_bench(extra_args=(), extra_env=None):
     env["JAX_PLATFORMS"] = "tpu"
     env["DLT_PROBE_TIMEOUT"] = "30"
     env["DLT_HANDOFF_PATH"] = LATEST
+    env["DLT_HANDOFF_TRACKED_PATH"] = ""  # never read the repo's real mirror
     env.update(extra_env or {})
     p = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--steps", "4",
@@ -105,6 +106,82 @@ def test_no_handoff_file_reports_unreachable():
     assert rc == 2
     assert out["value"] == 0.0
     assert "TPU unreachable" in out["error"]
+
+
+def test_tracked_mirror_served_when_latest_missing(handoff_file):
+    """The 2026-07-31 03:15 container restart deleted the gitignored
+    BENCH_latest.json; the git-tracked mirror must keep serving the result."""
+    mirror = os.path.join(_SCRATCH, "BENCH_handoff.json")
+    payload = {"result": dict(RESULT), "captured_unix": time.time() - 900,
+               "captured_at": "test", "argv": "bench.py --steps 32"}
+    with open(mirror, "w") as f:
+        json.dump(payload, f)
+    try:
+        assert not os.path.exists(LATEST)
+        rc, out = _run_bench(extra_env={"DLT_HANDOFF_TRACKED_PATH": mirror})
+        assert rc == 0
+        assert out["value"] == RESULT["value"]
+        assert out["provenance"] == "warm-runner"
+        assert 890 < out["age_s"] < 1000
+    finally:
+        os.remove(mirror)
+
+
+def test_freshest_handoff_wins(handoff_file):
+    """When both handoff files parse, the younger capture is served (the
+    runner refreshes BENCH_latest between mirror commits — and after a restore
+    the mirror may be the younger one)."""
+    handoff_file(age_s=3000)  # LATEST: older
+    mirror = os.path.join(_SCRATCH, "BENCH_handoff.json")
+    fresh = dict(RESULT, value=61.5)
+    payload = {"result": fresh, "captured_unix": time.time() - 300,
+               "captured_at": "test", "argv": "bench.py --steps 32"}
+    with open(mirror, "w") as f:
+        json.dump(payload, f)
+    try:
+        rc, out = _run_bench(extra_env={"DLT_HANDOFF_TRACKED_PATH": mirror})
+        assert rc == 0
+        assert out["value"] == 61.5
+        assert 290 < out["age_s"] < 400
+    finally:
+        os.remove(mirror)
+
+
+def test_future_timestamp_handoff_refused(handoff_file):
+    """A captured_unix far in the future (corrupt or hand-edited) must not be
+    served: negative age would otherwise shadow every legitimate file AND make
+    the staleness ceiling unreachable."""
+    handoff_file(age_s=-2 * 3600)
+    rc, out = _run_bench()
+    assert rc == 2
+    assert out["value"] == 0.0
+
+
+def test_tracked_mirror_git_commit_of_untracked_file(tmp_path):
+    """Pin the git sequence commit_tracked_handoff relies on: a pathspec commit
+    alone REJECTS an untracked file ('did not match any file(s) known to git'),
+    so the helper must add-then-commit — in a scratch repo, never the real one."""
+    import subprocess
+
+    sys.path.insert(0, os.path.join(REPO, "perf"))
+    from persistent_bench import _git_commit_path
+
+    repo = str(tmp_path)
+    subprocess.run(["git", "init", "-q", repo], check=True)
+    subprocess.run(["git", "-C", repo, "-c", "user.name=t",
+                    "-c", "user.email=t@t", "commit", "-q", "--allow-empty",
+                    "-m", "root"], check=True)
+    mirror = os.path.join(repo, "BENCH_handoff.json")
+    with open(mirror, "w") as f:
+        json.dump({"result": dict(RESULT)}, f)
+    ok, detail = _git_commit_path(repo, mirror)
+    assert ok, detail
+    tracked = subprocess.run(["git", "-C", repo, "ls-files", mirror],
+                             capture_output=True, text=True)
+    assert tracked.stdout.strip()  # the mirror is now tracked + committed
+    # second call with no change: ok without a new commit
+    ok, detail = _git_commit_path(repo, mirror)
+    assert ok and detail == "unchanged"
 
 
 def test_string_timestamp_handoff_still_served(handoff_file):
