@@ -172,9 +172,11 @@ def run_completion(state: ApiState, body: dict, emit):
         emit(text)
 
     streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
-    # NaiveCache prefix reuse: rewind pos to the common token prefix
+    # NaiveCache prefix reuse: rewind pos to the common token prefix (seek
+    # also restores the paged hot ring from the host store — a bare pos
+    # assignment would leave wrapped slots holding the abandoned branch's rows)
     reuse = state.cache.resolve(prompt)
-    engine.pos = reuse
+    engine.seek(reuse)
     delta_prompt = prompt[reuse:]
 
     try:
@@ -315,6 +317,12 @@ def main(argv=None) -> None:
         if args.sp > 1:
             p.error("--batch > 1 requires --sp 1: per-row cache positions are "
                     "incompatible with the sequence-sharded (ring) cache")
+        if args.kv_cache_storage in ("host", "disc"):
+            # refuse loudly rather than silently allocating the full-seq_len
+            # HBM cache in exactly the overflow scenario the flag exists for
+            p.error("--kv-cache-storage host|disc requires --batch 1: the "
+                    "paged cache is single-sequence. For long-context serving "
+                    "use --sp (more chips) or --batch 1.")
         import jax.numpy as jnp
 
         from ..runtime.batch_engine import BatchEngine
@@ -336,6 +344,9 @@ def main(argv=None) -> None:
         sampler = make_sampler(args, batch_engine.spec)
         print(f"⏩ Continuous batching: {args.batch} slots")
     else:
+        from .dllama import check_kv_storage
+
+        check_kv_storage(args)  # paged-mode cost notice (same as the CLI)
         engine = make_engine(args)
         sampler = make_sampler(args, engine.spec)
     server = serve(engine, args.host, args.port,

@@ -93,25 +93,39 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                    help="decode CHUNK tokens per dispatch with the on-device scan loop "
                         "(runtime/device_loop.py); 0 = per-token host loop")
     p.add_argument("--nthreads", type=int, default=None, help="ignored (XLA owns the chip)")
-    p.add_argument("--kv-cache-storage", default=None, choices=["ram", "disc"],
-                   help="reference compat flag. 'disc' (mmap'd disk KV cache, "
-                        "transformer.cpp:312-318) is NOT supported on TPU — the cache "
-                        "lives in HBM; shard the sequence axis across chips with --sp "
-                        "for contexts that overflow one chip (see README)")
+    p.add_argument("--kv-cache-storage", default=None,
+                   choices=["ram", "host", "disc"],
+                   help="'ram' (default): KV cache in HBM. 'host'/'disc': paged "
+                        "out-of-core cache (runtime/paged_cache.py) — a device "
+                        "hot ring of --kv-cache-resident recent positions plus "
+                        "the full history in host RAM / an mmap'd disk file "
+                        "pair (the reference's disc cache, transformer.cpp:"
+                        "312-318, rebuilt flash-attention-style). Capacity "
+                        "valve: exact attention over the whole context at "
+                        "host-bandwidth speed; use --sp to go FAST instead")
+    p.add_argument("--kv-cache-resident", type=int, default=1024, metavar="R",
+                   help="paged mode: positions kept HBM-resident (rounded up "
+                        "to a multiple of 64)")
+    p.add_argument("--kv-cache-dir", default=None, metavar="DIR",
+                   help="paged 'disc' mode: directory for the key/value cache "
+                        "files (default: a fresh temp dir)")
     return p
 
 
 def check_kv_storage(args) -> None:
     """The reference's `--kv-cache-storage disc` spills the KV cache to mmap'd disk
     files (src/transformer.cpp:312-318, utils.cpp:50-67) — an out-of-core valve for
-    small-RAM CPU nodes. On TPU the cache must sit in HBM to be usable by the chip at
-    all; paging it over the ~PCIe-class tunnel would be orders of magnitude slower than
-    decode itself. The TPU-native valve is sequence-parallel cache sharding (--sp,
-    ring attention over ICI). Warn loudly instead of silently accepting."""
-    if args.kv_cache_storage == "disc":
-        print("⚠️  --kv-cache-storage disc is not supported on TPU: the KV cache lives "
-              "in HBM.\n⚠️  For contexts larger than one chip's HBM, shard the cache "
-              "sequence axis with --sp N (ring attention); see README §long-context.",
+    small-RAM CPU nodes. The paged cache (runtime/paged_cache.py) is the TPU-native
+    equivalent: hot ring in HBM, full history on host/disk, exact merged attention.
+    State the cost up front — every decoded token re-reads the cold history from
+    host memory, so throughput falls with context length; --sp (ring attention over
+    ICI) is the FAST long-context path when more chips are available."""
+    if args.kv_cache_storage in ("host", "disc"):
+        print(f"💡 paged KV cache ({args.kv_cache_storage}): hot ring of "
+              f"{args.kv_cache_resident} positions in HBM, full history "
+              f"{'on disk (mmap)' if args.kv_cache_storage == 'disc' else 'in host RAM'}."
+              " Decode slows as the cold history grows; prefer --sp N when "
+              "more chips are available (README §long-context).",
               file=sys.stderr)
 
 
@@ -152,6 +166,9 @@ def make_engine(args) -> Engine:
         compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1,
         cache_write=args.cache_write, moe_sharding=args.moe_sharding,
         fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
+        kv_cache_storage=args.kv_cache_storage,
+        kv_cache_resident=args.kv_cache_resident,
+        kv_cache_dir=args.kv_cache_dir,
     )
     print(f"⏩ Loaded model in {time.perf_counter() - t0:.1f}s "
           f"(tp={engine.tp}, pallas={engine.use_pallas})")

@@ -81,7 +81,7 @@ def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> 
 
 def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos,
                positions, axis_name, sp_axis_name, sp_size, use_pallas, compress,
-               window, deferred_write=False, prologue=False):
+               window, deferred_write=False, prologue=False, paged_cold=None):
     """Sharded attention sub-block against the FULL stacked caches (L, B, hk, S, hs).
 
     Head counts in bp may be TP-local slices; the cache sequence axis may be sp-sharded
@@ -191,6 +191,38 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
                              axis_size=sp_size)
         kc = jax.lax.dynamic_update_slice(kc, kl[None], (layer_idx, 0, 0, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, vl[None], (layer_idx, 0, 0, 0, 0))
+    elif deferred_write and paged_cold is not None:
+        # Paged (out-of-core) cache: the device cache's S axis is a RING of the
+        # R most recent positions (slot = position mod R); everything older lives
+        # in the host store, and its attention contribution arrives as a
+        # (normalized output, lse) partial from the per-layer host callback —
+        # merged with the hot segment by the flash-attention segment identity
+        # (ops/attention.py merge_attention_partials). TPU-native equivalent of
+        # the reference's mmap'd disk KV cache (transformer.cpp:312-318): same
+        # capacity valve, but the resident window stays HBM-fast and only the
+        # cold history pays host bandwidth.
+        k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)  # (B, hk, T, hs)
+        v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
+        kl = jax.lax.dynamic_slice(kc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
+        vl = jax.lax.dynamic_slice(vc, (layer_idx, 0, 0, 0, 0), (1, b, hk, s, hs))[0]
+        # slot j's most recent committed position: p_j = j + R*floor((pos-1-j)/R)
+        # (< start_pos by construction; negative = never written = masked). The
+        # committed ring covers exactly [max(0, start_pos-R), start_pos) — the
+        # host cold segment covers [0, max(0, start_pos-R)) with no overlap.
+        slot = jnp.arange(s)
+        p_j = slot + s * jnp.floor_divide(start_pos - 1 - slot, s)
+        slot_pos = jnp.where(p_j >= 0, p_j, jnp.int32(1 << 30))
+        key_pos = jnp.concatenate([slot_pos, start_pos + jnp.arange(t)])
+        from ..ops.attention import gqa_attention_lse, merge_attention_partials
+
+        out_h, lse_h = gqa_attention_lse(
+            q, jnp.concatenate([kl, k_t], axis=2),
+            jnp.concatenate([vl, v_t], axis=2), positions, key_positions=key_pos)
+        out_c, lse_c = paged_cold(layer_idx, q.astype(jnp.float32), start_pos)
+        att = merge_attention_partials(out_h, lse_h, out_c, lse_c)
+        att = att.reshape(b, t, hq_local * hs).astype(x.dtype)
+        attn_out = project_out(att)
+        return attn_out, (k_t, v_t)  # caller commits into ring slots (mod R)
     elif deferred_write:
         # deferred-write path: the caches are loop-INVARIANT inside the layer scan —
         # attention reads the window of COMMITTED rows (positions < start_pos) and
@@ -489,7 +521,7 @@ def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, comp
 
 def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions,
            axis_name, sp_axis_name, sp_size, use_pallas, compress, window,
-           kc_ro=None, vc_ro=None, prologue=False):
+           kc_ro=None, vc_ro=None, prologue=False, paged_cold=None):
     """One transformer block as a scan step. Two cache disciplines:
 
     - in-scan (kc_ro is None): caches travel in the carry and are updated in place
@@ -507,7 +539,8 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
     attn_out, kvout = _attention(x, bp, layer_idx, spec, rope, kc, vc, start_pos,
                                  positions, axis_name, sp_axis_name, sp_size,
                                  use_pallas, compress, window,
-                                 deferred_write=deferred, prologue=prologue)
+                                 deferred_write=deferred, prologue=prologue,
+                                 paged_cold=paged_cold)
     if not deferred:
         kc, vc = kvout
     if spec.arch_type == ArchType.GROK1:
@@ -535,7 +568,7 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             sp_axis_name: str | None = None, sp_size: int = 1,
             use_pallas: bool = False, compress_collectives: bool = False,
             attn_window: int | None = None, cache_write: str = "inscan",
-            fused_prologue: bool = False):
+            fused_prologue: bool = False, paged_cold=None):
     """Run T tokens through the model against the KV cache.
 
     tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar
@@ -582,6 +615,12 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
     assert cache_write in ("inscan", "deferred"), cache_write
     deferred = cache_write == "deferred"
     sp_active = sp_axis_name is not None and sp_size > 1
+    if paged_cold is not None:
+        assert deferred and not sp_active and start_pos.ndim == 0, (
+            "paged KV cache requires the deferred discipline, no sp sharding, "
+            "and a scalar start_pos")
+        assert t <= k_cache.shape[3], (
+            f"chunk {t} exceeds the {k_cache.shape[3]}-slot resident ring")
     # fused rmsnorm+quantize prologue (ops/pallas_prologue.py): single-row decode
     # only (the kernels take one activation row), opt-in via fused_prologue
     if fused_prologue:
@@ -597,14 +636,23 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
                                  window=attn_window,
                                  kc_ro=k_cache if deferred else None,
                                  vc_ro=v_cache if deferred else None,
-                                 prologue=fused_prologue)
+                                 prologue=fused_prologue, paged_cold=paged_cold)
     layer_ids = jnp.arange(spec.n_layers, dtype=jnp.int32)
     if deferred:
         x, (k_rows, v_rows) = jax.lax.scan(
             block_fn, x, (params["blocks"], layer_ids))
         # commit all layers' new rows in one write per cache: (L, B, hk, T, hs)
         # lands at [.., .., .., start_pos : start_pos+T, ..]
-        if sp_active:
+        if paged_cold is not None:
+            # ring commit: position p lands in slot p mod R (scatter — the
+            # chunk may wrap the ring boundary). The rows being overwritten
+            # need no flush: the HOST store is authoritative for every
+            # committed position (Engine writes the same rows there).
+            ring = k_cache.shape[3]
+            idx = (start_pos + jnp.arange(t)) % ring
+            k_cache = k_cache.at[:, :, :, idx, :].set(k_rows)
+            v_cache = v_cache.at[:, :, :, idx, :].set(v_rows)
+        elif sp_active:
             # sequence-sharded caches: masked window write into the owning
             # shards, striped layout (see the _attention sp-deferred branch)
             k_cache, v_cache = commit_kv_rows_sharded(
@@ -632,6 +680,10 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
         logits = jax.lax.all_gather(logits, axis_name, axis=-1, tiled=True)
     if spec.arch_type == ArchType.GROK1:
         logits = logits * GROK_LOGITS_SCALE
+    if paged_cold is not None:
+        # the new rows ride out so the caller can append them to the host
+        # store — the step's one extra device->host payload (L, B, hk, T, hs)
+        return logits, k_cache, v_cache, (k_rows, v_rows)
     return logits, k_cache, v_cache
 
 

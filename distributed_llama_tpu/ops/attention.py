@@ -57,6 +57,61 @@ def gqa_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return out.reshape(b, t, hq * hs).astype(q.dtype)
 
 
+def gqa_attention_lse(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                      positions: jax.Array,
+                      key_positions: jax.Array | None = None
+                      ) -> tuple[jax.Array, jax.Array]:
+    """gqa_attention that ALSO returns the log-sum-exp of the (masked) scores.
+
+    The flash-attention segment form: a softmax over keys split across segments
+    equals merge_attention_partials() of each segment's (normalized output, lse).
+    Used by the paged KV cache (runtime/paged_cache.py) to combine the device-
+    resident hot ring with the host-resident cold history — the TPU-native
+    answer to the reference's mmap'd disk KV cache (transformer.cpp:312-318).
+
+    Returns (out (B, T, hq, hs) f32, lse (B, T, hq) f32); fully-masked rows give
+    out 0 and lse -inf (a zero-weight segment under the merge)."""
+    b, t, hq, hs = q.shape
+    _, hk, s, _ = k_cache.shape
+    g = hq // hk
+    qg = q.reshape(b, t, hk, g, hs)
+    scale = 1.0 / math.sqrt(hs)
+    scores = jnp.einsum("btkgd,bksd->bkgts", qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale  # (B, hk, g, T, S)
+    if key_positions is None:
+        key_positions = jnp.arange(s)
+    if positions.ndim == 1:
+        mask = (key_positions[None, :] <= positions[:, None])[None, None, None]
+    else:
+        kp = key_positions if key_positions.ndim == 2 else key_positions[None, :]
+        mask = (kp[:, None, :] <= positions[:, :, None])[:, None, None]
+    neg = jnp.finfo(jnp.float32).min
+    sm = jnp.where(mask, scores, neg)
+    m = jnp.max(sm, axis=-1)  # (B, hk, g, T)
+    e = jnp.where(mask, jnp.exp(sm - m[..., None]), 0.0)
+    l = jnp.sum(e, axis=-1)  # (B, hk, g, T)
+    out = jnp.einsum("bkgts,bksd->btkgd", e, v_cache.astype(jnp.float32))
+    l_t = jnp.transpose(l, (0, 3, 1, 2))  # (B, T, hk, g)
+    m_t = jnp.transpose(m, (0, 3, 1, 2))
+    out = out / jnp.maximum(l_t, 1e-30)[..., None]
+    lse = jnp.where(l_t > 0.0, m_t + jnp.log(jnp.maximum(l_t, 1e-30)), -jnp.inf)
+    return out.reshape(b, t, hq, hs), lse.reshape(b, t, hq)
+
+
+def merge_attention_partials(out_a: jax.Array, lse_a: jax.Array,
+                             out_b: jax.Array, lse_b: jax.Array) -> jax.Array:
+    """Combine two attention segments' (normalized output, lse) into the exact
+    full-softmax output: softmax weights re-derive from exp(lse_i - max) and an
+    empty segment (lse -inf) contributes zero weight. out_*: (..., hs),
+    lse_*: (...) matching out's leading axes."""
+    m = jnp.maximum(lse_a, lse_b)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)  # both segments empty: output zeros
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    den = jnp.maximum(wa + wb, 1e-30)[..., None]
+    return (out_a * wa[..., None] + out_b * wb[..., None]) / den
+
+
 def update_kv_cache(k_cache: jax.Array, v_cache: jax.Array, k_new: jax.Array,
                     v_new: jax.Array, start_pos: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Write T new kv vectors at [start_pos, start_pos+T) into head-major caches.

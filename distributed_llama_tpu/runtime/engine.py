@@ -86,7 +86,10 @@ class Engine:
                  compress_collectives: bool = False, batch: int = 1,
                  pod: bool = False, cache_write: str | None = None,
                  moe_sharding: str = "slice", fused_prologue: bool | None = None,
-                 prefill_kernel: bool | None = None):
+                 prefill_kernel: bool | None = None,
+                 kv_cache_storage: str | None = None,
+                 kv_cache_resident: int = 1024,
+                 kv_cache_dir: str | None = None):
         self.spec = spec
         self.tokenizer = tokenizer
         on_tpu = jax.default_backend() == "tpu"
@@ -98,6 +101,17 @@ class Engine:
         self.compress = compress_collectives
         if use_pallas is None:
             use_pallas = on_tpu
+        # one rounded resident value drives every paged-mode decision (the
+        # fits-check, the tp default, and the ring allocation) — three
+        # different thresholds here previously let `--kv-cache-resident 1000`
+        # page against a ring rounded up to the full seq_len (empty cold,
+        # pure callback overhead forever)
+        self.kv_resident = max(64, (kv_cache_resident + 63) // 64 * 64)
+        assert kv_cache_storage in (None, "ram", "host", "disc"), kv_cache_storage
+        self.paged = (kv_cache_storage in ("host", "disc")
+                      and spec.seq_len > self.kv_resident)
+        if self.paged and tp is None:
+            tp = 1  # paged mode is single-chip; don't let the mesh grab every device
         if pod:
             # multi-host job: mesh over EVERY chip in the job (the SPMD replacement
             # for the reference's worker fleet, dllama.cpp:205-221). Caller must have
@@ -165,6 +179,29 @@ class Engine:
         self.decode_weight_bytes = decode_stream_bytes(self.params, spec)
         self.rope = RopeTables.create(spec)
         self.batch = batch
+        # Paged (out-of-core) KV cache — the reference's --kv-cache-storage
+        # disc rebuilt TPU-native (runtime/paged_cache.py): device hot ring +
+        # authoritative host/disk store + per-layer cold-attention callbacks.
+        # A capacity valve for contexts whose cache exceeds HBM; single-chip,
+        # single-sequence (use --sp to go FAST at long context instead).
+        self.store = None
+        if kv_cache_storage in ("host", "disc") and not self.paged:
+            import sys
+
+            print(f"💡 kv-cache-storage={kv_cache_storage} ignored: the full "
+                  f"seq_len {spec.seq_len} cache fits the {self.kv_resident}-"
+                  "slot resident budget (nothing to page)", file=sys.stderr)
+        if self.paged:
+            assert self.tp == 1 and sp == 1 and dp == 1 and batch == 1, (
+                "paged KV cache is single-chip, single-sequence (tp=sp=dp="
+                "batch=1); shard the cache over chips with --sp instead")
+            from .paged_cache import HostKVStore
+
+            host_dtype = (np.float32 if self.dtype == jnp.float32
+                          else np.dtype(jnp.bfloat16))
+            self.store = HostKVStore(spec, self.kv_resident, batch=1,
+                                     storage=kv_cache_storage,
+                                     directory=kv_cache_dir, dtype=host_dtype)
         self._steps: dict[int | None, object] = {}  # attn_window bucket -> jitted step
         self.k_cache, self.v_cache = self._init_cache()
         self.pos = 0
@@ -181,6 +218,8 @@ class Engine:
     def _window_for(self, pos_end: int) -> int | None:
         """Smallest window bucket covering cache positions [0, pos_end)."""
         s = self.spec.seq_len
+        if self.paged:
+            return None  # the hot ring IS the window; cold attends on host
         if self.sp > 1 and self.cache_write != "deferred":
             return None  # contiguous (inscan) ring walks the full sharded cache
         if s <= self._WINDOW_MIN:
@@ -191,6 +230,22 @@ class Engine:
         return None if w >= s else w
 
     def _step_for(self, window: int | None):
+        if window == "paged_warm":
+            # warm phase of the paged engine: while pos + T <= resident the
+            # ring layout coincides with a plain cache prefix (slot ==
+            # position) and the cold segment is provably empty — run the
+            # ordinary deferred step over the ring-sized caches and skip the
+            # n_layers host callback round-trips per step entirely
+            window = None
+        elif self.paged:
+            if "paged" not in self._steps:
+                from .paged_cache import make_paged_step
+
+                self._steps["paged"] = make_paged_step(
+                    self.spec, self.store, dtype=self.dtype,
+                    use_pallas=self.use_pallas,
+                    fused_prologue=self.fused_prologue)
+            return self._steps["paged"]
         if window not in self._steps:
             self._steps[window] = make_sharded_forward(
                 self.spec, self.mesh, self.params, dtype=self.dtype,
@@ -219,6 +274,11 @@ class Engine:
         return cls(spec, params, tokenizer, **kw)
 
     def _init_cache(self):
+        if self.paged:
+            from .paged_cache import init_ring_cache
+
+            return init_ring_cache(self.spec, self.kv_resident, batch=1,
+                                   dtype=self.dtype)
         from ..parallel.tp import init_sharded_kv_cache
 
         return init_sharded_kv_cache(self.spec, self.mesh, batch=self.batch,
@@ -226,6 +286,31 @@ class Engine:
 
     def reset(self) -> None:
         self.pos = 0
+
+    def seek(self, pos: int) -> None:
+        """Set the decode position (prefix reuse rewind, api_server NaiveCache).
+
+        Plain mode: the full cache keeps every position, so moving pos is
+        enough. Paged mode: after a wrap, ring slots hold rows from the
+        ABANDONED continuation's later positions, which the slot-position
+        formula (models/forward.py paged branch) would mislabel as earlier
+        committed rows — restore the ring from the authoritative host store
+        (zeros for never-written slots are masked arithmetically)."""
+        assert 0 <= pos <= self.pos, f"seek({pos}) past live context {self.pos}"
+        if self.paged and pos < self.pos:
+            L, B, hk, R, hs = self.k_cache.shape
+            lo = max(0, pos - R)
+            kr = np.zeros((L, B, hk, R, hs), np.float32)
+            vr = np.zeros_like(kr)
+            if pos > lo:
+                idx = np.arange(lo, pos) % R
+                kr[:, :, :, idx] = np.asarray(self.store.k[:, :, :, lo:pos],
+                                              np.float32)
+                vr[:, :, :, idx] = np.asarray(self.store.v[:, :, :, lo:pos],
+                                              np.float32)
+            self.k_cache = jnp.asarray(kr, self.dtype)
+            self.v_cache = jnp.asarray(vr, self.dtype)
+        self.pos = pos
 
     def _pos_arg(self, pos):
         """start_pos step argument: scalar normally, per-row (B,) under dp sharding
@@ -300,7 +385,14 @@ class Engine:
         t = len(tokens)
         if self.pos + t > self.spec.seq_len:
             raise ValueError(f"context overflow: pos {self.pos} + {t} > {self.spec.seq_len}")
-        step = self._step_for(self._window_for(self.pos + t))
+        if self.paged:
+            # warm phase (pos + T within the ring) takes the callback-free
+            # plain step; the paged step only builds once real cold history
+            # is about to exist
+            step = self._step_for("paged_warm" if self.pos + t <= self.kv_resident
+                                  else None)
+        else:
+            step = self._step_for(self._window_for(self.pos + t))
         # the host loop drives ONE sequence; with batch>1 slots (BatchEngine backing
         # store) or dp sharding, tile the row across the batch so token/cache/pos
         # shapes stay congruent (rows 1.. do redundant work; BatchEngine drives the
@@ -314,9 +406,29 @@ class Engine:
                   "Use BatchEngine (api_server --batch) to drive real per-row "
                   "requests.", file=sys.stderr)
         toks = jnp.tile(jnp.asarray(tokens)[None, :], (self.batch, 1))
-        logits, self.k_cache, self.v_cache = step(
-            self.params, self.rope, toks, self.k_cache,
-            self.v_cache, self._pos_arg(self.pos))
+        if self.paged and self.pos + t <= self.kv_resident:
+            # warm phase: slot == position, cold empty — plain deferred step
+            # (see _step_for), with the new rows sliced from the committed ring
+            # for the host-store append (the authoritative history the paged
+            # step's cold callbacks will read once the ring wraps)
+            logits, self.k_cache, self.v_cache = step(
+                self.params, self.rope, toks, self.k_cache,
+                self.v_cache, self._pos_arg(self.pos))
+            self.store.append(
+                np.asarray(self.k_cache[:, :, :, self.pos:self.pos + t]),
+                np.asarray(self.v_cache[:, :, :, self.pos:self.pos + t]),
+                self.pos)
+        elif self.paged:
+            logits, self.k_cache, self.v_cache, (k_rows, v_rows) = step(
+                self.params, self.rope, toks, self.k_cache,
+                self.v_cache, self._pos_arg(self.pos))
+            # the host store is the authoritative history the next step's
+            # cold callbacks read — append before advancing pos
+            self.store.append(np.asarray(k_rows), np.asarray(v_rows), self.pos)
+        else:
+            logits, self.k_cache, self.v_cache = step(
+                self.params, self.rope, toks, self.k_cache,
+                self.v_cache, self._pos_arg(self.pos))
         self.pos += t
         return np.asarray(logits)[0, -1]
 
@@ -371,8 +483,15 @@ class Engine:
         """generate / generate_chunked dispatch: chunk > 0 selects the on-device scan
         loop. The single switch point for every app surface's --device-loop flag."""
         if device_loop_chunk > 0:
-            return self.generate_chunked(prompt_tokens, max_tokens, sampler,
-                                         chunk=device_loop_chunk, **kw)
+            if self.paged:
+                import sys
+
+                print("⚠️  --device-loop is incompatible with the paged KV "
+                      "cache (host-store appends happen between dispatches); "
+                      "using the host loop.", file=sys.stderr)
+            else:
+                return self.generate_chunked(prompt_tokens, max_tokens, sampler,
+                                             chunk=device_loop_chunk, **kw)
         return self.generate(prompt_tokens, max_tokens, sampler, **kw)
 
     # ------------------------------------------------------------------
