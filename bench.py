@@ -278,6 +278,28 @@ def metric_name(args) -> str:
     return f"{args.arch}_q40_{kind}_tok_s"
 
 
+_sentinel_owned = False  # did THIS process write the driver sentinel?
+
+
+def _exit_now(code: int):
+    """Exit WITHOUT running atexit/teardown. A probe that timed out leaves a
+    half-initialized PJRT client whose shutdown hooks can block forever against
+    a wedged tunnel — observed 2026-07-31 04:10: bench printed its JSON line,
+    then hung in interpreter teardown until the caller's 300 s watchdog killed
+    it (losing the rc). Never called under DLT_WARM_RUNNER (in-process bench
+    must raise SystemExit, not kill the runner). Removes the driver sentinel
+    ONLY if this process created it — a test-mode subprocess must not delete a
+    real concurrent driver's pause marker."""
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        if _sentinel_owned and os.path.exists(SENTINEL):
+            os.remove(SENTINEL)
+    except OSError:
+        pass
+    os._exit(code)
+
+
 def probe_backend(timeout_s: float | None = None) -> tuple[str | None, str]:
     """Resolve the backend AND fence a tiny op under a watchdog. The axon tunnel can
     wedge such that even backend initialization hangs forever (observed 2026-07-29:
@@ -359,7 +381,12 @@ def main():
     ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
 
     skip_probe = False
-    if not os.environ.get("DLT_WARM_RUNNER") and os.environ.get("JAX_PLATFORMS") != "cpu":
+    if (not os.environ.get("DLT_WARM_RUNNER")
+            and not os.environ.get("DLT_HANDOFF_PATH")  # test scratch mode:
+            # a test subprocess must not announce itself as THE driver bench —
+            # a full pytest run was pausing the real warm runner for the
+            # sentinel's whole 180 s foreign-grace tail per test file
+            and os.environ.get("JAX_PLATFORMS") != "cpu"):
         # announce this process to the warm runner (perf/persistent_bench.py) so
         # it pauses its refresh loop — the tunnel wedges under concurrent jobs.
         # Removed on exit; a crash leaves it to the runner's mtime expiry.
@@ -367,9 +394,11 @@ def main():
         import threading
 
         def _touch():
+            global _sentinel_owned
             try:
                 with open(SENTINEL, "w") as f:
                     f.write(str(time.time()))
+                _sentinel_owned = True
             except OSError:
                 pass
 
@@ -445,7 +474,9 @@ def main():
                 out["captured_at"] = payload.get("captured_at")
                 out["probe_failure_at_capture"] = fail[:200]
                 print(json.dumps(out))
-                return
+                if os.environ.get("DLT_WARM_RUNNER"):
+                    return
+                _exit_now(0)
             except (KeyError, ValueError, TypeError) as e:
                 fail += f" | BENCH_latest.json unusable: {e!r}"
         print(json.dumps({
@@ -453,7 +484,9 @@ def main():
             "vs_baseline": 0.0,
             "error": f"TPU unreachable: {fail}",
         }))
-        sys.exit(2)
+        if os.environ.get("DLT_WARM_RUNNER"):
+            sys.exit(2)
+        _exit_now(2)
 
     on_tpu = backend == "tpu"
     spec = ModelSpec(**(SMALL if args.small else ARCHS[args.arch])).resolved()

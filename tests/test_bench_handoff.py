@@ -46,6 +46,9 @@ def _run_bench(extra_args=(), extra_env=None):
     env["DLT_PROBE_TIMEOUT"] = "30"
     env["DLT_HANDOFF_PATH"] = LATEST
     env["DLT_HANDOFF_TRACKED_PATH"] = ""  # never read the repo's real mirror
+    # never wait on the REAL warm runner's busy marker (a live runner mid-config
+    # in this repo would stall every subprocess here for its full busy_wait)
+    env["DLT_BUSY_WAIT"] = "0"
     env.update(extra_env or {})
     p = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--steps", "4",
@@ -182,6 +185,25 @@ def test_tracked_mirror_git_commit_of_untracked_file(tmp_path):
     # second call with no change: ok without a new commit
     ok, detail = _git_commit_path(repo, mirror)
     assert ok and detail == "unchanged"
+
+
+def test_test_mode_subprocess_preserves_foreign_sentinel(handoff_file):
+    """A scratch-mode (DLT_HANDOFF_PATH) bench subprocess neither creates the
+    real driver sentinel nor deletes one a concurrent REAL driver owns — a
+    test run must not un-pause the warm runner mid-driver-bench."""
+    handoff_file(age_s=600)
+    sentinel = os.path.join(REPO, "perf", ".driver_bench_active")
+    existed = os.path.exists(sentinel)
+    try:
+        if not existed:
+            with open(sentinel, "w") as f:
+                f.write(str(time.time()))
+        rc, out = _run_bench()
+        assert rc == 0
+        assert os.path.exists(sentinel), "test subprocess deleted a foreign sentinel"
+    finally:
+        if not existed and os.path.exists(sentinel):
+            os.remove(sentinel)
 
 
 def test_string_timestamp_handoff_still_served(handoff_file):
