@@ -272,9 +272,11 @@ def vs_baseline(args, tok_s: float):
 
 
 def metric_name(args) -> str:
-    kind = "prefill" if args.prefill > 0 else "decode"
+    kind = ("prefill" if args.prefill > 0
+            else "paged_decode" if getattr(args, "kv_paged", 0) > 0 else "decode")
     if args.small:
-        return f"small_{kind}_tok_s" if kind == "prefill" else "small_q40_decode_tok_s"
+        return (f"small_{kind}_tok_s" if kind == "prefill"
+                else f"small_q40_{kind}_tok_s")
     return f"{args.arch}_q40_{kind}_tok_s"
 
 
@@ -362,6 +364,11 @@ def main():
                     help="fused rmsnorm+quantize prologue kernels "
                          "(ops/pallas_prologue.py) feeding the inline-Xexp "
                          "matvec variants — opt-in until the hardware A/B lands")
+    ap.add_argument("--kv-paged", type=int, default=0, metavar="R",
+                    help="bench the paged (out-of-core) KV cache: hot ring of "
+                         "R positions + host cold store, decode timed with "
+                         "~128 cold positions (runtime/paged_cache.py). "
+                         "Documents the capacity valve's real per-token cost")
     ap.add_argument("--prefill-kernel", action="store_true",
                     help="fused dequant-matmul for M>1 (ops/pallas_q4_mm.py): "
                          "weights stream once at 4-bit density instead of the "
@@ -377,8 +384,12 @@ def main():
         getattr(args, k) == ap.get_default(k)
         for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
                   "window", "cache_write", "no_fuse", "prologue",
-                  "prefill_kernel")
+                  "prefill_kernel", "kv_paged")
     ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
+    if args.kv_paged > 0 and args.tp > 1:
+        # before any mesh/device work so the error beats a mesh-size crash
+        ap.error("--kv-paged is single-chip (the paged step is an unsharded "
+                 "program; Engine enforces the same)")
 
     skip_probe = False
     if (not os.environ.get("DLT_WARM_RUNNER")
@@ -504,6 +515,76 @@ def main():
     mesh = make_mesh(tp=args.tp)
     rope = RopeTables.create(spec)
     state = {}
+
+    if args.kv_paged > 0:
+        # paged-cache rung: mirrors Engine's two-phase drive (plain deferred
+        # step while the ring fills, paged step once cold history exists) so
+        # the timed region measures exactly what a user of
+        # --kv-cache-storage host pays per token. No fallback ladder — a
+        # lowering failure here is an explicit error record, not a downgrade.
+        from distributed_llama_tpu.parallel.tp import (  # noqa: E402
+            make_sharded_forward)
+        from distributed_llama_tpu.runtime.paged_cache import (  # noqa: E402
+            HostKVStore, init_ring_cache, make_paged_step)
+
+        resident = max(64, (args.kv_paged + 63) // 64 * 64)
+        cold_target = min(128, spec.seq_len - resident - args.steps - 66)
+        if cold_target < 64:
+            ap.error(f"--kv-paged {resident}: ring + >=64 cold + timed steps "
+                     f"must fit seq_len {spec.seq_len}")
+        params = shard_params(synth_params(spec, layout, tp=args.tp), mesh, spec)
+        state.update(wbytes=decode_stream_bytes(params, spec))
+        store = HostKVStore(spec, resident, storage="host",
+                           dtype=(np.float32 if dtype == jnp.float32
+                                  else np.dtype(jnp.bfloat16)))
+        kc, vc = init_ring_cache(spec, resident, dtype=dtype)
+        warm_step = make_sharded_forward(spec, mesh, params, dtype=dtype,
+                                         use_pallas=on_tpu, donate_cache=True,
+                                         attn_window=None,
+                                         cache_write="deferred")
+        paged_step = make_paged_step(spec, store, dtype=dtype,
+                                     use_pallas=on_tpu)
+        toks64 = jnp.ones((1, 64), jnp.int32)
+        pos = 0
+        while pos + 64 <= resident:  # fill the ring callback-free
+            logits, kc, vc = warm_step(params, rope, toks64, kc, vc,
+                                       jnp.int32(pos))
+            store.append(np.asarray(kc[:, :, :, pos:pos + 64]),
+                         np.asarray(vc[:, :, :, pos:pos + 64]), pos)
+            pos += 64
+        while pos < resident + cold_target:  # build real cold history
+            logits, kc, vc, (kr, vr) = paged_step(params, rope, toks64, kc, vc,
+                                                  jnp.int32(pos))
+            store.append(np.asarray(kr), np.asarray(vr), pos)
+            pos += 64
+        tokp = jnp.asarray([[1]], jnp.int32)
+        for _ in range(2):  # compile + warm the T=1 paged program
+            logits, kc, vc, (kr, vr) = paged_step(params, rope, tokp, kc, vc,
+                                                  jnp.int32(pos))
+            store.append(np.asarray(kr), np.asarray(vr), pos)
+            pos += 1
+        np.asarray(logits[0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            logits, kc, vc, (kr, vr) = paged_step(params, rope, tokp, kc, vc,
+                                                  jnp.int32(pos))
+            store.append(np.asarray(kr), np.asarray(vr), pos)
+            pos += 1
+        np.asarray(logits[0, 0, 0])
+        dt = (time.perf_counter() - t0) / args.steps
+        cold = pos - resident
+        print(json.dumps({
+            "metric": metric_name(args),
+            "value": round(1.0 / dt, 3), "unit": "tok/s", "vs_baseline": None,
+            "ms_per_token": round(dt * 1e3, 3), "resident": resident,
+            "cold_positions": cold, "layout": layout,
+            "weight_gb": round(state["wbytes"] / 1e9, 3),
+            "achieved_gbps": round(state["wbytes"] / 1e9 / dt, 1),
+            "cold_gb_per_token": round(
+                spec.n_layers * 2 * spec.n_kv_heads * cold * spec.head_size
+                * store.k.itemsize / 1e9, 3),
+        }))
+        return
 
     def build(lay):
         params = shard_params(

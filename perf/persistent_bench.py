@@ -71,6 +71,9 @@ CONFIGS = [
     ["--steps", "32", "--device-loop", "8"],
     ["--steps", "64", "--device-loop", "32"],
     ["--steps", "64", "--window", "2048"],
+    # paged out-of-core cache: the capacity valve's real per-token cost with
+    # ~128 cold positions (slow by design — host callbacks over the tunnel)
+    ["--steps", "8", "--kv-paged", "1024"],
     # post-deferred profiler trace (VERDICT r4 item 4: where does the residual
     # non-kernel time go once the carry copies are gone?)
     ["--steps", "8", "--profile-dir", "perf/r5_trace"],

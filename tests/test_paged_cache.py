@@ -175,6 +175,17 @@ def test_paged_seek_restores_ring_after_wrap(spec_params):
                                    err_msg=f"post-seek divergence at +{i}")
 
 
+def test_moe_paged_matches_full_cache():
+    """The paged branch is arch-independent (_attention only); pin that with a
+    Mixtral-shaped MoE spec across the cold boundary."""
+    spec = ModelSpec(arch_type=ArchType.MIXTRAL, dim=64, hidden_dim=96,
+                     n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=96,
+                     seq_len=256, n_experts=4, n_active_experts=2).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=13)
+    ref, paged = _engines(spec, params, "host")
+    _drive(ref, paged, np.random.default_rng(6), n_steps=100)
+
+
 def test_disc_store_cleanup_owned_tempdir(spec_params):
     """A store that mkdtemp'd its own directory deletes it on cleanup();
     a caller-supplied directory is owner-kept."""
