@@ -92,6 +92,12 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
     p.add_argument("--device-loop", type=int, default=0, metavar="CHUNK",
                    help="decode CHUNK tokens per dispatch with the on-device scan loop "
                         "(runtime/device_loop.py); 0 = per-token host loop")
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="prompt-lookup speculative decoding: draft up to K "
+                        "tokens from context n-gram matches and verify them in "
+                        "one step (runtime/speculative.py). Greedy-only "
+                        "(temperature 0); emits exactly the sequential loop's "
+                        "tokens. No reference counterpart")
     p.add_argument("--nthreads", type=int, default=None, help="ignored (XLA owns the chip)")
     p.add_argument("--kv-cache-storage", default=None,
                    choices=["ram", "host", "disc"],
@@ -205,7 +211,8 @@ def mode_inference(args) -> None:
         pieces.append(piece)
 
     out, stats = engine.generate_with(prompt, args.steps, sampler, on_token=on_token,
-                                      device_loop_chunk=args.device_loop)
+                                      device_loop_chunk=args.device_loop,
+                         speculative_k=args.speculative)
     text = b"".join(pieces).decode("utf-8", errors="replace")
     print(text)
     # per-token stats table like dllama.cpp:76-93. The reference's columns are G(total),
@@ -246,7 +253,8 @@ def mode_generate(args) -> None:
 
     engine.generate_with(prompt, args.steps, sampler, on_token=on_token,
                          stop_check=lambda t: t == tok.eos_id,
-                         device_loop_chunk=args.device_loop)
+                         device_loop_chunk=args.device_loop,
+                         speculative_k=args.speculative)
     print()
 
 
@@ -293,7 +301,8 @@ def mode_chat(args) -> None:
         engine.generate_with(prompt, engine.spec.seq_len - engine.pos - 1, sampler,
                              on_token=streamer.on_token,
                              stop_check=streamer.stop_check,
-                             device_loop_chunk=args.device_loop)
+                             device_loop_chunk=args.device_loop,
+                         speculative_k=args.speculative)
         if engine.pos >= engine.spec.seq_len - 1:
             print("\n(context end reached)")
             break

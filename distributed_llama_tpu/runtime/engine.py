@@ -299,17 +299,42 @@ class Engine:
         assert 0 <= pos <= self.pos, f"seek({pos}) past live context {self.pos}"
         if self.paged and pos < self.pos:
             L, B, hk, R, hs = self.k_cache.shape
-            lo = max(0, pos - R)
-            kr = np.zeros((L, B, hk, R, hs), np.float32)
-            vr = np.zeros_like(kr)
-            if pos > lo:
-                idx = np.arange(lo, pos) % R
-                kr[:, :, :, idx] = np.asarray(self.store.k[:, :, :, lo:pos],
-                                              np.float32)
-                vr[:, :, :, idx] = np.asarray(self.store.v[:, :, :, lo:pos],
-                                              np.float32)
-            self.k_cache = jnp.asarray(kr, self.dtype)
-            self.v_cache = jnp.asarray(vr, self.dtype)
+            n_stale = self.pos - pos
+            if n_stale < R:
+                # targeted patch (the speculative-decoding rollback path runs
+                # this EVERY step with a rejected draft — a full ring rebuild
+                # + HBM re-upload here would dwarf the step being saved):
+                # each rolled-back position's slot must revert to its previous
+                # occupant (position q-R, from the host store); slots whose
+                # previous occupant is negative never held a valid row below
+                # the new frontier and stay masked by the slot-position
+                # formula regardless of content.
+                stale = np.arange(pos, self.pos)
+                prev = stale - R
+                valid = prev >= 0
+                if valid.any():
+                    slots = jnp.asarray(stale[valid] % R)
+                    krows = jnp.asarray(
+                        np.asarray(self.store.k[:, :, :, prev[valid]],
+                                   np.float32), self.dtype)
+                    vrows = jnp.asarray(
+                        np.asarray(self.store.v[:, :, :, prev[valid]],
+                                   np.float32), self.dtype)
+                    self.k_cache = self.k_cache.at[:, :, :, slots, :].set(krows)
+                    self.v_cache = self.v_cache.at[:, :, :, slots, :].set(vrows)
+            else:
+                # rolled back a full wrap or more: rebuild the ring outright
+                lo = max(0, pos - R)
+                kr = np.zeros((L, B, hk, R, hs), np.float32)
+                vr = np.zeros_like(kr)
+                if pos > lo:
+                    idx = np.arange(lo, pos) % R
+                    kr[:, :, :, idx] = np.asarray(self.store.k[:, :, :, lo:pos],
+                                                  np.float32)
+                    vr[:, :, :, idx] = np.asarray(self.store.v[:, :, :, lo:pos],
+                                                  np.float32)
+                self.k_cache = jnp.asarray(kr, self.dtype)
+                self.v_cache = jnp.asarray(vr, self.dtype)
         self.pos = pos
 
     def _pos_arg(self, pos):
@@ -381,6 +406,11 @@ class Engine:
         """Run a chunk of tokens at the current position; returns last-token logits
         (vocab,) and advances pos. Bounds-checked against seq_len (the reference hard-stops
         at context end, dllama.cpp:190-192)."""
+        return self._infer(tokens)[-1]
+
+    def _infer(self, tokens: list[int] | np.ndarray) -> np.ndarray:
+        """One step over T tokens; returns all T positions' logits (T, vocab)
+        and advances pos (shared body of infer_chunk / infer_chunk_logits)."""
         tokens = np.asarray(tokens, dtype=np.int32)
         t = len(tokens)
         if self.pos + t > self.spec.seq_len:
@@ -430,7 +460,25 @@ class Engine:
                 self.params, self.rope, toks, self.k_cache,
                 self.v_cache, self._pos_arg(self.pos))
         self.pos += t
-        return np.asarray(logits)[0, -1]
+        return np.asarray(logits)[0]
+
+    def infer_chunk_logits(self, tokens: list[int] | np.ndarray) -> np.ndarray:
+        """infer_chunk, but returns ALL T positions' logits (T, vocab) — the
+        verify step of speculative decoding (runtime/speculative.py) needs
+        every position's argmax. Advances pos by T like infer_chunk;
+        speculative callers seek() back to the verified frontier."""
+        return self._infer(tokens)
+
+    def generate_speculative(self, prompt_tokens: list[int], max_tokens: int,
+                             sampler, *, k: int = 8, on_token=None,
+                             stop_check=None):
+        """Greedy prompt-lookup speculative decoding (runtime/speculative.py):
+        emits exactly generate()'s tokens, usually in fewer dispatches."""
+        from .speculative import generate_speculative
+
+        return generate_speculative(self, prompt_tokens, max_tokens, sampler,
+                                    k=k, on_token=on_token,
+                                    stop_check=stop_check)
 
     def prefill(self, tokens: list[int], stats: GenerationStats | None = None) -> np.ndarray:
         """Chunked prompt ingestion; returns logits after the last prompt token."""
@@ -478,10 +526,22 @@ class Engine:
         return out, stats
 
     def generate_with(self, prompt_tokens: list[int], max_tokens: int, sampler,
-                      *, device_loop_chunk: int = 0, **kw
-                      ) -> tuple[list[int], GenerationStats]:
-        """generate / generate_chunked dispatch: chunk > 0 selects the on-device scan
-        loop. The single switch point for every app surface's --device-loop flag."""
+                      *, device_loop_chunk: int = 0, speculative_k: int = 0,
+                      **kw) -> tuple[list[int], GenerationStats]:
+        """generate / generate_chunked / generate_speculative dispatch — the
+        single switch point for every app surface's --device-loop and
+        --speculative flags. Speculation is greedy-only (temperature 0) and
+        wins over the device loop when both are requested."""
+        if speculative_k > 0:
+            if getattr(sampler, "temperature", 0.0) == 0.0:
+                return self.generate_speculative(prompt_tokens, max_tokens,
+                                                 sampler, k=speculative_k, **kw)
+            import sys
+
+            print("⚠️  --speculative is greedy-only (temperature 0); falling "
+                  "back to the "
+                  + ("on-device loop." if device_loop_chunk > 0 and not self.paged
+                     else "sequential host loop."), file=sys.stderr)
         if device_loop_chunk > 0:
             if self.paged:
                 import sys

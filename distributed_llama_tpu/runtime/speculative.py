@@ -1,0 +1,137 @@
+"""Prompt-lookup speculative decoding (greedy, model-free).
+
+No reference counterpart — a beyond-parity decode accelerator that exploits the
+TPU decode regime: a T = 1+k verify step streams the weights ONCE for k+1
+tokens, so on a bandwidth-bound chip it costs roughly one decode step. Drafts
+come from the context itself (n-gram suffix lookup, the "prompt lookup
+decoding" technique): find the most recent earlier occurrence of the current
+tail n-gram and propose the tokens that followed it. Repetitive workloads
+(code, chat templates, retrieval contexts) accept long drafts; adversarial
+text degrades gracefully to ~1 token/step plus one wasted row of compute.
+
+Exactness: greedy acceptance emits EXACTLY the tokens the sequential host loop
+would (each accepted token equals the argmax the step itself produced; the
+first mismatch is replaced by the step's own argmax — the standard greedy
+speculative identity). Sampling (temperature > 0) is NOT supported — the
+caller falls back to the sequential loop.
+
+Rollback is free under the repo's cache disciplines: rows committed for
+rejected positions sit BEYOND the rewound start_pos, and every read path masks
+slots >= start_pos (deferred window masks, ring attention live_end, paged ring
+slot formula), so the next step simply overwrites them. Engine.seek() handles
+the paged hot ring's wrapped slots.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def propose_ngram(tokens: list[int], k: int, *, max_ngram: int = 4,
+                  min_ngram: int = 1) -> list[int]:
+    """Draft up to k tokens by matching the longest tail n-gram earlier in
+    `tokens` (most recent occurrence wins) and copying its continuation.
+
+    Pure host-side list scan — O(len * ngram) worst case on small ints,
+    negligible next to a decode step."""
+    n = len(tokens)
+    if n < min_ngram + 1 or k <= 0:
+        return []
+    for size in range(min(max_ngram, n - 1), min_ngram - 1, -1):
+        tail = tokens[n - size:]
+        # most recent earlier occurrence of the tail n-gram; start <= n-size-1
+        # guarantees the continuation slice holds at least one token
+        for start in range(n - size - 1, -1, -1):
+            if tokens[start:start + size] == tail:
+                return list(tokens[start + size:start + size + k])
+    return []
+
+
+def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
+                         sampler, *, k: int = 8, on_token=None,
+                         stop_check=None):
+    """Greedy generation with prompt-lookup drafts; returns (tokens, stats)
+    exactly equal to engine.generate()'s output for temperature 0.
+
+    Each iteration runs ONE step over [last_token] + draft (T <= 1+k),
+    accepts the matching prefix, emits the step's own argmax as the
+    correction, and rewinds the cache to the verified frontier via
+    engine.seek(). Extra stats fields: spec_steps (verify dispatches),
+    spec_drafted, spec_accepted (draft tokens that matched)."""
+    from .engine import GenerationStats
+    import time
+
+    assert getattr(sampler, "temperature", 0.0) == 0.0, (
+        "speculative decoding is greedy-only; use the sequential loop for "
+        "temperature > 0")
+    stats = GenerationStats()
+    # modeled traffic only: the T=1+k verify program's collectives differ from
+    # the traced T=1 step's (the logits all-gather scales with T) — presenting
+    # another program's trace as "measured" is the round-1 defect
+    # _fill_traffic's provenance flag exists to prevent
+    engine._fill_traffic(stats)
+    stats.spec_steps = 0
+    stats.spec_drafted = 0
+    stats.spec_accepted = 0
+
+    history = list(prompt_tokens)
+    if len(prompt_tokens) > 1:
+        # prefill everything but the last prompt token; each verify block
+        # starts with the pending token, so its logits re-derive in-block
+        engine.prefill(prompt_tokens[:-1], stats)
+    stats.prompt_tokens = len(prompt_tokens)
+    out: list[int] = []
+    last = prompt_tokens[-1]
+    done = False
+    while not done and len(out) < max_tokens:
+        t0 = time.perf_counter()
+        room = engine.spec.seq_len - engine.pos - 1
+        if room <= 0:
+            break
+        # draft cap room-1, not room: emitting full[i] is sequential-legal only
+        # while the ingest position after it stays BELOW seq_len (the
+        # sequential loop breaks at pos >= seq_len before sampling again), so
+        # the block may fill at most up to position seq_len-1
+        draft = propose_ngram(history,
+                              min(k, room - 1, max_tokens - len(out) - 1))
+        block = [last] + draft
+        pos_before = engine.pos
+        full = engine.infer_chunk_logits(block)  # (T, vocab)
+        stats.spec_steps += 1
+        stats.spec_drafted += len(draft)
+        accepted = 0
+        emitted: list[int] = []
+        for i in range(len(block)):
+            target = sampler.sample(full[i])  # argmax w/ sampler's tie-breaks
+            emitted.append(target)
+            if i < len(draft) and target == draft[i]:
+                accepted += 1
+            else:
+                break
+        stats.spec_accepted += accepted
+        dt_ms = (time.perf_counter() - t0) * 1000.0 / len(emitted)
+        stop_j = None
+        for j, tok in enumerate(emitted):
+            out.append(tok)
+            history.append(tok)
+            stats.generated_tokens += 1
+            stats.token_ms.append(dt_ms)
+            stats.infer_ms.append(dt_ms)
+            if on_token is not None:
+                on_token(tok)
+            if stop_check is not None and stop_check(tok):
+                done = True
+                stop_j = j
+                break
+            if len(out) >= max_tokens:
+                break
+        # rewind to the verified frontier: rows beyond it were computed from
+        # rejected inputs (masked reads make the stale rows invisible). On a
+        # stop at emitted index j the frontier excludes the stop token's
+        # ingestion — the sequential loop breaks before inferring it.
+        frontier = pos_before + 1 + (stop_j if stop_j is not None else accepted)
+        engine.seek(frontier)
+        last = out[-1]
+        if engine.pos >= engine.spec.seq_len:
+            break
+    return out, stats
