@@ -51,7 +51,7 @@ class NaiveCache:
 class ApiState:
     def __init__(self, engine: Engine, template_type: TemplateType,
                  default_sampler: Sampler, device_loop_chunk: int = 0,
-                 batch_engine=None):
+                 batch_engine=None, speculative_k: int = 0):
         self.engine = engine
         self.batch_engine = batch_engine  # BatchEngine when --batch > 1, else None
         self.lock = threading.Lock()
@@ -60,6 +60,7 @@ class ApiState:
         self.template = ChatTemplate(template_type, tok.chat_template, tok.eos_piece())
         self.default_sampler = default_sampler
         self.device_loop_chunk = device_loop_chunk
+        self.speculative_k = speculative_k
         self.model_name = "distributed-llama-tpu"
 
 
@@ -183,7 +184,14 @@ def run_completion(state: ApiState, body: dict, emit):
         out, _stats = engine.generate_with(delta_prompt, max_tokens, sampler,
                                            on_token=streamer.on_token,
                                            stop_check=streamer.stop_check,
-                                           device_loop_chunk=state.device_loop_chunk)
+                                           device_loop_chunk=state.device_loop_chunk,
+                                           speculative_k=state.speculative_k,
+                                           # full conversation (incl. the reused
+                                           # prefix) for the n-gram proposer —
+                                           # delta_prompt alone would starve
+                                           # prompt-lookup of exactly the
+                                           # repetitive history it draws from
+                                           history_tokens=prompt)
     except Exception:
         # KV may hold a half-written new conversation; drop the reuse index entirely
         state.cache.update([])
@@ -282,11 +290,13 @@ class Handler(BaseHTTPRequestHandler):
 def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           template_type: TemplateType = TemplateType.UNKNOWN,
           default_sampler: Sampler | None = None,
-          device_loop_chunk: int = 0, batch_engine=None) -> ThreadingHTTPServer:
+          device_loop_chunk: int = 0, batch_engine=None,
+          speculative_k: int = 0) -> ThreadingHTTPServer:
     runner = batch_engine or engine
     state = ApiState(engine, template_type,
                      default_sampler or Sampler(runner.spec.vocab_size, 0.7, 0.9, 0),
-                     device_loop_chunk, batch_engine=batch_engine)
+                     device_loop_chunk, batch_engine=batch_engine,
+                     speculative_k=speculative_k)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = ThreadingHTTPServer((host, port), handler)
     print(f"🟢 dllama-api listening on {host}:{port}")
@@ -323,6 +333,10 @@ def main(argv=None) -> None:
             p.error("--kv-cache-storage host|disc requires --batch 1: the "
                     "paged cache is single-sequence. For long-context serving "
                     "use --sp (more chips) or --batch 1.")
+        if args.speculative > 0:
+            p.error("--speculative requires --batch 1: the continuous-batching "
+                    "scheduler decodes all slots in one batched step and has "
+                    "no per-request verify dispatch.")
         import jax.numpy as jnp
 
         from ..runtime.batch_engine import BatchEngine
@@ -352,7 +366,7 @@ def main(argv=None) -> None:
     server = serve(engine, args.host, args.port,
                    TemplateType(args.chat_template) if args.chat_template
                    else TemplateType.UNKNOWN, sampler, args.device_loop,
-                   batch_engine=batch_engine)
+                   batch_engine=batch_engine, speculative_k=args.speculative)
     server.serve_forever()
 
 

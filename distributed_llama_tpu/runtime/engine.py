@@ -471,14 +471,16 @@ class Engine:
 
     def generate_speculative(self, prompt_tokens: list[int], max_tokens: int,
                              sampler, *, k: int = 8, on_token=None,
-                             stop_check=None):
+                             stop_check=None,
+                             history_tokens: list[int] | None = None):
         """Greedy prompt-lookup speculative decoding (runtime/speculative.py):
         emits exactly generate()'s tokens, usually in fewer dispatches."""
         from .speculative import generate_speculative
 
         return generate_speculative(self, prompt_tokens, max_tokens, sampler,
                                     k=k, on_token=on_token,
-                                    stop_check=stop_check)
+                                    stop_check=stop_check,
+                                    history_tokens=history_tokens)
 
     def prefill(self, tokens: list[int], stats: GenerationStats | None = None) -> np.ndarray:
         """Chunked prompt ingestion; returns logits after the last prompt token."""
@@ -527,21 +529,31 @@ class Engine:
 
     def generate_with(self, prompt_tokens: list[int], max_tokens: int, sampler,
                       *, device_loop_chunk: int = 0, speculative_k: int = 0,
+                      history_tokens: list[int] | None = None,
                       **kw) -> tuple[list[int], GenerationStats]:
         """generate / generate_chunked / generate_speculative dispatch — the
         single switch point for every app surface's --device-loop and
         --speculative flags. Speculation is greedy-only (temperature 0) and
-        wins over the device loop when both are requested."""
+        wins over the device loop when both are requested. history_tokens
+        (optional, speculative only): full already-cached context for the
+        n-gram proposer when prompt_tokens is a prefix-reuse delta."""
         if speculative_k > 0:
             if getattr(sampler, "temperature", 0.0) == 0.0:
                 return self.generate_speculative(prompt_tokens, max_tokens,
-                                                 sampler, k=speculative_k, **kw)
-            import sys
+                                                 sampler, k=speculative_k,
+                                                 history_tokens=history_tokens,
+                                                 **kw)
+            if not getattr(self, "_warned_spec_fallback", False):
+                # once per engine, not per request — a serving default of
+                # temperature 0.7 would otherwise print this on every call
+                self._warned_spec_fallback = True
+                import sys
 
-            print("⚠️  --speculative is greedy-only (temperature 0); falling "
-                  "back to the "
-                  + ("on-device loop." if device_loop_chunk > 0 and not self.paged
-                     else "sequential host loop."), file=sys.stderr)
+                print("⚠️  --speculative is greedy-only (temperature 0); "
+                      "falling back to the "
+                      + ("on-device loop" if device_loop_chunk > 0
+                         and not self.paged else "sequential host loop")
+                      + " for sampled requests.", file=sys.stderr)
         if device_loop_chunk > 0:
             if self.paged:
                 import sys

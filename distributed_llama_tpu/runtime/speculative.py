@@ -49,7 +49,8 @@ def propose_ngram(tokens: list[int], k: int, *, max_ngram: int = 4,
 
 def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
                          sampler, *, k: int = 8, on_token=None,
-                         stop_check=None):
+                         stop_check=None,
+                         history_tokens: list[int] | None = None):
     """Greedy generation with prompt-lookup drafts; returns (tokens, stats)
     exactly equal to engine.generate()'s output for temperature 0.
 
@@ -74,7 +75,14 @@ def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
     stats.spec_drafted = 0
     stats.spec_accepted = 0
 
-    history = list(prompt_tokens)
+    # the proposer's corpus: the FULL conversation when the caller prefix-
+    # reused most of it (api_server passes history_tokens=whole prompt while
+    # prompt_tokens is just the delta) — prompt-lookup draws its drafts from
+    # exactly that repetitive history
+    assert history_tokens is None or (
+        history_tokens[-len(prompt_tokens):] == list(prompt_tokens)), (
+        "history_tokens must end with prompt_tokens")
+    history = list(history_tokens) if history_tokens else list(prompt_tokens)
     if len(prompt_tokens) > 1:
         # prefill everything but the last prompt token; each verify block
         # starts with the pending token, so its logits re-derive in-block

@@ -33,6 +33,8 @@ def server(tmp_path_factory):
         max_token_length=12, chat_template="{{<|im_start|>}}"))
 
     engine = Engine.load(mpath, tpath, tp=1)
+    global _MODEL_FILES
+    _MODEL_FILES = (mpath, tpath)  # for tests that spin up a second server
     srv = serve(engine, host="127.0.0.1", port=0, template_type=TemplateType.CHATML)
     port = srv.server_address[1]
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -109,6 +111,35 @@ def test_prefix_cache_consistency(server):
                {"messages": msgs2, "max_tokens": 4, "temperature": 0})
     assert (json.loads(r2.read())["choices"][0]["message"]["content"] ==
             json.loads(r3.read())["choices"][0]["message"]["content"])
+
+
+def test_speculative_server_matches_plain(server, tmp_path_factory):
+    """A --speculative server must return exactly what the plain server
+    returns for greedy requests (the flag only changes dispatch count),
+    and must silently fall back for temperature > 0."""
+    msgs = [{"role": "user", "content": "ab ab ab ab"}]
+    plain = json.loads(_post(server, "/v1/chat/completions",
+                             {"messages": msgs, "max_tokens": 8,
+                              "temperature": 0}).read())
+    mpath, tpath = _MODEL_FILES
+    eng = Engine.load(mpath, tpath, tp=1)
+    srv = serve(eng, host="127.0.0.1", port=0,
+                template_type=TemplateType.CHATML, speculative_k=6)
+    port2 = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        spec_r = json.loads(_post(port2, "/v1/chat/completions",
+                                  {"messages": msgs, "max_tokens": 8,
+                                   "temperature": 0}).read())
+        assert (spec_r["choices"][0]["message"]["content"]
+                == plain["choices"][0]["message"]["content"])
+        sampled = _post(port2, "/v1/chat/completions",
+                        {"messages": msgs, "max_tokens": 4,
+                         "temperature": 0.8, "seed": 5})
+        assert sampled.status == 200  # graceful fallback, not an error
+    finally:
+        srv.shutdown()
+        srv.server_close()
 
 
 def test_bad_json_rejected(server):
