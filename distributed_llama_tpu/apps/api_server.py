@@ -292,6 +292,11 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           default_sampler: Sampler | None = None,
           device_loop_chunk: int = 0, batch_engine=None,
           speculative_k: int = 0) -> ThreadingHTTPServer:
+    if batch_engine is not None and speculative_k > 0:
+        # guard EVERY caller, not just the CLI: the batch scheduler has no
+        # per-request verify dispatch, so the flag would be silently inert
+        raise ValueError("speculative_k requires batch_engine=None "
+                         "(continuous batching has no verify dispatch)")
     runner = batch_engine or engine
     state = ApiState(engine, template_type,
                      default_sampler or Sampler(runner.spec.vocab_size, 0.7, 0.9, 0),

@@ -113,7 +113,7 @@ def test_prefix_cache_consistency(server):
             json.loads(r3.read())["choices"][0]["message"]["content"])
 
 
-def test_speculative_server_matches_plain(server, tmp_path_factory):
+def test_speculative_server_matches_plain(server):
     """A --speculative server must return exactly what the plain server
     returns for greedy requests (the flag only changes dispatch count),
     and must silently fall back for temperature > 0."""
@@ -137,6 +137,46 @@ def test_speculative_server_matches_plain(server, tmp_path_factory):
                         {"messages": msgs, "max_tokens": 4,
                          "temperature": 0.8, "seed": 5})
         assert sampled.status == 200  # graceful fallback, not an error
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_paged_server_multi_turn_consistency(server):
+    """A --kv-cache-storage host server serving alternating conversations
+    exercises Engine.seek()'s ring restore (wrapped slots hold the abandoned
+    branch's rows); greedy outputs must match the plain server's."""
+    mpath, tpath = _MODEL_FILES
+    eng = Engine.load(mpath, tpath, kv_cache_storage="host",
+                      kv_cache_resident=64)
+    assert eng.paged  # seq_len 128 > resident 64
+    srv = serve(eng, host="127.0.0.1", port=0,
+                template_type=TemplateType.CHATML)
+    port2 = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        # conversation A (long enough to wrap the 64-slot ring: the byte-
+        # fallback vocab costs ~3 tokens per "ab ", so ~75 prompt tokens),
+        # then B, then REPEAT A — the repeat rewinds to A's prefix through the
+        # wrapped ring and must reproduce the plain server's continuation
+        a1 = [{"role": "user", "content": "ab " * 20}]
+        b1 = [{"role": "user", "content": "cd cd cd"}]
+        for p in (server, port2):
+            r0 = json.loads(_post(p, "/v1/chat/completions",
+                                  {"messages": a1, "max_tokens": 6,
+                                   "temperature": 0}).read())
+            assert "choices" in r0, r0  # prompt must FIT (no overflow 400)
+        assert eng.pos > 64, "conversation A never wrapped the 64-slot ring"
+        outs = {}
+        for p in (server, port2):
+            json.loads(_post(p, "/v1/chat/completions",
+                             {"messages": b1, "max_tokens": 4,
+                              "temperature": 0}).read())
+            r = json.loads(_post(p, "/v1/chat/completions",
+                                 {"messages": a1, "max_tokens": 6,
+                                  "temperature": 0}).read())
+            outs[p] = r["choices"][0]["message"]["content"]
+        assert outs[server] == outs[port2]
     finally:
         srv.shutdown()
         srv.server_close()

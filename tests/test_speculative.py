@@ -135,6 +135,34 @@ def test_speculative_context_end_matches(spec_params):
     assert len(out_seq) <= spec.seq_len - len(prompt) + 1
 
 
+def test_speculative_on_sharded_engine(spec_params):
+    """Speculation composes with tp x sp sharding: rollback rides the ring's
+    live_end masking; tokens match the unsharded sequential engine."""
+    spec, params = spec_params
+    a = Engine(spec, dict(params), tp=1, dtype=jnp.float32)
+    b = Engine(spec, dict(params), tp=2, sp=2, dtype=jnp.float32)
+    prompt = [3, 7, 11, 3, 7, 11, 3, 7]
+    _compare(a, b, prompt, 32, spec)
+
+
+def test_speculative_history_tokens_prefix_reuse(spec_params):
+    """The api_server path: prompt_tokens is a reuse delta while
+    history_tokens carries the full conversation for the proposer — output
+    must equal decoding the delta without history (exactness is independent
+    of the draft corpus)."""
+    spec, params = spec_params
+    full = [3, 7, 11] * 8
+    a = Engine(spec, dict(params), tp=1, dtype=jnp.float32)
+    a.prefill(full[:20])
+    out_a, _ = a.generate(full[20:], 24, _greedy(spec))
+    b = Engine(spec, dict(params), tp=1, dtype=jnp.float32)
+    b.prefill(full[:20])
+    out_b, st = b.generate_speculative(full[20:], 24, _greedy(spec),
+                                       history_tokens=full)
+    assert out_a == out_b
+    assert st.spec_accepted > 0  # the full-history corpus produced drafts
+
+
 def test_speculative_rejects_sampling(spec_params):
     spec, params = spec_params
     b = Engine(spec, dict(params), tp=1, dtype=jnp.float32)
