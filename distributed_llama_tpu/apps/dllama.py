@@ -19,7 +19,7 @@ import sys
 
 from ..models.spec import ModelSpec
 from ..quants import FloatType
-from ..runtime.engine import Engine, GenerationStats
+from ..runtime.engine import Engine
 from ..runtime.sampler import Sampler
 from ..tokenizer import ChatItem, ChatTemplate, EosDetector, TemplateType
 from ..tokenizer.eos import TokenStreamer

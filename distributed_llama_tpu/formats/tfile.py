@@ -14,7 +14,7 @@ magic 0x567123 uses a fixed struct header.
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 MAGIC = 0x567124
 LEGACY_MAGIC = 0x567123

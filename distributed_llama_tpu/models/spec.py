@@ -9,7 +9,7 @@ formats/mfile.py).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 
 
 class ArchType(enum.IntEnum):

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..quants import QK, FloatType, QTensor
+from ..quants import QK, QTensor
 
 
 def _f16_bits_to_f32(h16):

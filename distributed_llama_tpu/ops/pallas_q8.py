@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..quants import QK, FloatType, QTensor
+from ..quants import QK, QTensor
 
 
 def _matvec_kernel(xexp_ref, sx_ref, w_ref, s_ref, o_ref):

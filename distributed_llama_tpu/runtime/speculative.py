@@ -24,7 +24,6 @@ the paged hot ring's wrapped slots.
 
 from __future__ import annotations
 
-import numpy as np
 
 
 def propose_ngram(tokens: list[int], k: int, *, max_ngram: int = 4,
