@@ -8,7 +8,6 @@ prefixes across requests on the same slot (the NaiveCache generalization).
 """
 
 import os
-import threading
 import time
 
 import pytest

@@ -7,14 +7,9 @@ device_sample must honor the reference Sampler's temperature/top-p semantics."""
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
-from distributed_llama_tpu.models.forward import init_kv_cache
 from distributed_llama_tpu.models.params import init_random_params
 from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
-from distributed_llama_tpu.ops.rope import RopeTables
-from distributed_llama_tpu.parallel.mesh import make_mesh
-from distributed_llama_tpu.parallel.tp import shard_params
 from distributed_llama_tpu.quants import FloatType
 from distributed_llama_tpu.runtime.device_loop import device_sample, make_decode_loop
 from distributed_llama_tpu.runtime.engine import Engine

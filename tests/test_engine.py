@@ -3,7 +3,6 @@ determinism, chunked-prefill equivalence, stats, and context-overflow handling."
 
 import numpy as np
 import pytest
-import jax.numpy as jnp
 
 from distributed_llama_tpu.formats.mfile import params_file_order, write_model
 from distributed_llama_tpu.formats.tfile import TokenizerData, write_tokenizer

@@ -8,7 +8,6 @@ including GQA, all three archs, and both rope layouts.
 """
 
 import numpy as np
-import pytest
 import jax.numpy as jnp
 
 from distributed_llama_tpu.models.forward import (
