@@ -236,6 +236,13 @@ def mode_inference(args) -> None:
               f"({engine.decode_weight_bytes / 1e9:.3f} GB/step global)")
     print(f"Prefill time:        {stats.prefill_ms:.2f} ms "
           f"({stats.prompt_tokens} tokens)")
+    if getattr(stats, "spec_steps", 0):
+        # speculative decoding: dispatches vs tokens is the whole story
+        acc = stats.spec_accepted / max(stats.spec_drafted, 1)
+        print(f"Speculative:         {stats.generated_tokens} tokens in "
+              f"{stats.spec_steps} verify steps "
+              f"({stats.spec_accepted}/{stats.spec_drafted} drafts accepted, "
+              f"{acc:.0%})")
 
 
 def mode_generate(args) -> None:
