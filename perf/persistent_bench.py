@@ -71,12 +71,13 @@ CONFIGS = [
     ["--steps", "32", "--device-loop", "8"],
     ["--steps", "64", "--device-loop", "32"],
     ["--steps", "64", "--window", "2048"],
-    # paged out-of-core cache: the capacity valve's real per-token cost with
-    # ~128 cold positions (slow by design — host callbacks over the tunnel)
-    ["--steps", "8", "--kv-paged", "1024"],
     # post-deferred profiler trace (VERDICT r4 item 4: where does the residual
     # non-kernel time go once the carry copies are gone?)
     ["--steps", "8", "--profile-dir", "perf/r5_trace"],
+    # LAST on purpose: the paged rung is the first pure_callback ever run over
+    # the tunnel — if host callbacks wedge, only the supervisor's stall budget
+    # is lost, not the jobs behind it
+    ["--steps", "8", "--kv-paged", "1024"],
 ]
 DRILL = ["--steps", "4"]
 
