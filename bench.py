@@ -18,6 +18,14 @@ costs. --layout i8 benches the older int8-plane kernel for comparison.
 
 Usage: python bench.py [--small] [--steps N] [--tp N] [--layout i4p|i8]
                        [--device-loop N] [--window W]
+                       [--batch B --superstep K]   (serving throughput mode)
+
+--batch B runs the BatchEngine's hot path — the batched K-step device loop
+(runtime/device_loop.py make_batched_decode_loop) over B cache rows — and
+reports `aggregate_decode_tok_s` (B rows x K tokens per dispatch / wall time)
+alongside per-stream tok/s. Decode is HBM-bound, so aggregate throughput
+should scale ~linearly with B until the batch turns compute-bound; the
+serving trajectory tracks B ∈ {1, 4, 8}.
 """
 
 import argparse
@@ -61,16 +69,27 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 # than a cold bench can init + compile (~20-40s); once the warm runner has
 # compiled a config, a fresh driver bench.py reuses the serialized executable
 # and only pays init. Harmless when cold (a miss just compiles normally).
-try:
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO_DIR, "perf", ".jax_cache"))
-except Exception as _e:  # older jax without the knob: run uncached
-    print(f"# compilation cache unavailable: {_e}", file=sys.stderr)
-else:
-    try:  # tuning knob only — cache stays active at the default threshold
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+#
+# Called from main() (and the warm runner), NOT at import: enabling it as an
+# import side effect leaked the cache into every importer — pytest's
+# collection imports bench (tests/test_bench_synth.py), which switched the
+# WHOLE test process onto the cache and poisoned the paged-cache tests:
+# executables whose programs embed per-layer pure_callbacks (paged cold
+# attention) round-trip through serialization with stale host-callback
+# bindings, yielding garbage logits on a warm-cache run (flaky
+# test_paged_server_multi_turn_consistency) and an occasional
+# munmap_chunk abort at interpreter teardown.
+def enable_compilation_cache():
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO_DIR, "perf", ".jax_cache"))
+    except Exception as _e:  # older jax without the knob: run uncached
+        print(f"# compilation cache unavailable: {_e}", file=sys.stderr)
+    else:
+        try:  # tuning knob only — cache stays active at the default threshold
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
 # runner -> driver result. DLT_HANDOFF_PATH overrides so tests exercise the
 # protocol against a scratch file instead of clobbering (and deleting!) a real
 # runner-published hardware result — which a test teardown did on 2026-07-31.
@@ -272,8 +291,16 @@ def vs_baseline(args, tok_s: float):
 
 
 def metric_name(args) -> str:
-    kind = ("prefill" if args.prefill > 0
-            else "paged_decode" if getattr(args, "kv_paged", 0) > 0 else "decode")
+    if getattr(args, "batch", 0) > 0:
+        # B and K are part of the metric identity: the serving trajectory
+        # tracks aggregate tok/s per (B, K) point across rounds. K mirrors
+        # the bench loop's clamp (max(superstep, 1)) so the label always
+        # names the configuration actually measured.
+        kind = f"b{args.batch}k{max(args.superstep, 1)}_decode"
+    else:
+        kind = ("prefill" if args.prefill > 0
+                else "paged_decode" if getattr(args, "kv_paged", 0) > 0
+                else "decode")
     if args.small:
         return (f"small_{kind}_tok_s" if kind == "prefill"
                 else f"small_q40_{kind}_tok_s")
@@ -335,6 +362,7 @@ def probe_backend(timeout_s: float | None = None) -> tuple[str | None, str]:
 
 
 def main():
+    enable_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true", help="tiny model (CI smoke)")
     ap.add_argument("--arch", choices=sorted(ARCHS), default="llama2_7b",
@@ -352,6 +380,12 @@ def main():
                     help="attention window bucket (cache positions decode reads)")
     ap.add_argument("--device-loop", type=int, default=0, metavar="N",
                     help="use the on-device scan loop, N tokens per dispatch")
+    ap.add_argument("--batch", type=int, default=0, metavar="B",
+                    help="serving-throughput mode: B cache rows decode through "
+                         "the batched K-step device loop (BatchEngine's hot "
+                         "path); reports aggregate_decode_tok_s = B*K/dispatch")
+    ap.add_argument("--superstep", type=int, default=8, metavar="K",
+                    help="decode steps fused per dispatch in --batch mode")
     ap.add_argument("--prefill", type=int, default=0, metavar="T",
                     help="bench chunked prefill throughput at chunk size T instead "
                          "of decode")
@@ -384,8 +418,12 @@ def main():
         getattr(args, k) == ap.get_default(k)
         for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
                   "window", "cache_write", "no_fuse", "prologue",
-                  "prefill_kernel", "kv_paged")
+                  "prefill_kernel", "kv_paged", "batch", "superstep")
     ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
+    if args.batch > 0 and (args.prefill > 0 or args.device_loop > 0
+                           or args.kv_paged > 0):
+        ap.error("--batch is its own mode (batched K-step decode); combine "
+                 "only with --superstep/--steps/--arch/--layout/--tp")
     if args.kv_paged > 0 and args.tp > 1:
         # before any mesh/device work so the error beats a mesh-size crash
         ap.error("--kv-paged is single-chip (the paged step is an unsharded "
@@ -506,8 +544,10 @@ def main():
     window = min(max(args.window, 64), spec.seq_len)
     # keep the documented start_pos + T <= attn_window contract: grow the bucket to
     # cover every decoded position (warm steps + timed steps, or the loop dispatches)
-    steps_end = 4 + args.steps if args.device_loop <= 0 else (
-        args.device_loop * (max(args.steps // args.device_loop, 1) + 1))
+    chunked = args.device_loop if args.device_loop > 0 else (
+        max(args.superstep, 1) if args.batch > 0 else 0)
+    steps_end = 4 + args.steps if chunked <= 0 else (
+        chunked * (max(args.steps // chunked, 1) + 1))
     while window < min(steps_end, spec.seq_len):
         window *= 2
     window = None if window >= spec.seq_len else window
@@ -591,7 +631,8 @@ def main():
             synth_params(spec, lay, fuse=not args.no_fuse, tp=args.tp), mesh, spec)
         state.update(params=params, layout=lay,
                      wbytes=decode_stream_bytes(params, spec))
-        kc, vc = init_sharded_kv_cache(spec, mesh, dtype=dtype)
+        kc, vc = init_sharded_kv_cache(spec, mesh, batch=max(args.batch, 1),
+                                       dtype=dtype)
         if lay == "i4p" and os.environ.get("DLT_FORCE_I4P_FAILURE"):
             # fallback-path drill: fail AFTER the full i4p set + caches occupy HBM,
             # exactly like a real lowering failure — proves the except-path drops
@@ -742,6 +783,66 @@ def main():
             out["prefill_kernel_coverage"] = round(eng_b / max(tot_b, 1), 3)
         else:
             out["prefill_kernel"] = False
+        if "fallback_reason" in state:
+            out["fallback_reason"] = state["fallback_reason"]
+        if args.profile_dir:
+            out["profiled"] = True
+        print(json.dumps(out))
+        return
+
+    if args.batch > 0:
+        # serving-throughput mode: the BatchEngine hot path (batched K-step
+        # device loop, all B rows active) measured standalone. One dispatch =
+        # B*K decoded tokens and ONE host sync.
+        from distributed_llama_tpu.runtime.device_loop import (
+            make_batched_decode_loop)
+
+        B, K = args.batch, max(args.superstep, 1)
+        zeros = np.zeros((B,), np.float32)
+        rng = np.zeros((B, 2), np.uint32)
+        ones_tok = np.ones((B,), np.int32)
+        full_budget = np.full((B,), K, np.int32)
+
+        def warm_bloop(params, kc, vc):
+            loop = make_batched_decode_loop(
+                spec, mesh, params, K, mode="greedy", dtype=dtype,
+                use_pallas=state["use_pallas"], attn_window=window,
+                cache_write=state["cache_write"],
+                fused_prologue=state["prologue"])
+            toks, _, kc, vc = loop(params, rope, ones_tok, kc, vc,
+                                   np.zeros((B,), np.int32), rng, zeros,
+                                   zeros + 0.9, full_budget)  # compile + warm
+            np.asarray(toks)
+            return loop, params, kc, vc
+
+        loop, params, kc, vc = compile_with_fallback(warm_bloop)
+        pos = K
+        n_disp = max(args.steps // K, 1)
+        with profile_ctx:
+            t0 = time.perf_counter()
+            for _ in range(n_disp):
+                toks, _, kc, vc = loop(params, rope, ones_tok, kc, vc,
+                                       np.full((B,), pos, np.int32), rng,
+                                       zeros, zeros + 0.9, full_budget)
+                pos += K
+            np.asarray(toks)
+            dt_disp = (time.perf_counter() - t0) / n_disp
+        per_stream = K / dt_disp
+        aggregate = B * per_stream
+        out = {
+            "metric": metric_name(args),
+            "value": round(aggregate, 3), "unit": "tok/s",
+            "vs_baseline": None,  # aggregate metric, not the 1-stream baseline
+            "aggregate_decode_tok_s": round(aggregate, 3),
+            "per_stream_tok_s": round(per_stream, 3),
+            "batch": B, "superstep": K,
+            "ms_per_dispatch": round(dt_disp * 1e3, 3),
+            "ms_per_token_per_stream": round(dt_disp / K * 1e3, 3),
+            "weight_gb": round(state["wbytes"] / 1e9, 3),
+            "achieved_gbps": round(state["wbytes"] / 1e9 / (dt_disp / K), 1),
+            "layout": state["layout"], "cache_write": state["cache_write"],
+            "attn_window": window or spec.seq_len, "steps": args.steps,
+        }
         if "fallback_reason" in state:
             out["fallback_reason"] = state["fallback_reason"]
         if args.profile_dir:

@@ -321,6 +321,12 @@ def main(argv=None) -> None:
                    help="continuous-batching slots: up to N requests decode "
                         "concurrently in one batched step (1 = reference-style "
                         "serialized serving)")
+    p.add_argument("--superstep", type=int, default=8,
+                   help="K-step device decode loop for --batch > 1: forward + "
+                        "sampling scan K tokens on device per dispatch (1 host "
+                        "sync per K tokens); the scheduler drops to single "
+                        "steps while a new request waits, so admission latency "
+                        "stays ~1 step. 1 = host-side sampling every token")
     p.add_argument("--dp", type=int, default=1,
                    help="data-parallel mesh axis: shard the --batch cache rows over "
                         "N device groups (requires --batch divisible by N)")
@@ -352,7 +358,8 @@ def main(argv=None) -> None:
             args.model, args.tokenizer, max_seq_len=args.max_seq_len,
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
-            slots=args.batch, tp=args.tp, dp=args.dp, pod=args.pod,
+            slots=args.batch, superstep=max(args.superstep, 1),
+            tp=args.tp, dp=args.dp, pod=args.pod,
             cache_write=args.cache_write, moe_sharding=args.moe_sharding,
             fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
             dtype=(None if args.dtype == "auto"
@@ -361,7 +368,8 @@ def main(argv=None) -> None:
             compress_collectives=args.buffer_float_type == "q80" and (args.tp or 1) > 1)
         engine = None
         sampler = make_sampler(args, batch_engine.spec)
-        print(f"⏩ Continuous batching: {args.batch} slots")
+        print(f"⏩ Continuous batching: {args.batch} slots, "
+              f"super-step K={batch_engine.superstep}")
     else:
         from .dllama import check_kv_storage
 

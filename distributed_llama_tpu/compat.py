@@ -1,0 +1,23 @@
+"""Version compatibility shims for the jax API surface this repo uses.
+
+The codebase targets current jax (`jax.shard_map` with `check_vma`); older
+runtimes (<= 0.4.x) only ship `jax.experimental.shard_map.shard_map`, whose
+replication-check kwarg is spelled `check_rep`. Every sharded entry point
+imports `shard_map` from here so one shim covers the whole repo.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

@@ -576,6 +576,15 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
     (continuous batching: each sequence decodes at its own position; the reference's
     single-slot pos has no analog). Returns (logits (B, T, vocab) f32, caches).
 
+    Per-row start_pos also carries MIXED batches (BatchEngine): rows need not
+    all use their T positions — a decode row in a (B, T=chunk) prefill step
+    puts its one real token at index 0 and scratch beyond. Causal masking
+    confines token 0's attention to the row's committed history plus itself,
+    so its logits[row, 0] equal a T=1 step's, and the scratch writes land on
+    positions > start_pos that every read path masks until the row's own
+    later tokens overwrite them. The batched decode scan
+    (runtime/device_loop.py) parks finished rows on the same invariant.
+
     cache_write selects the cache discipline:
     - "inscan": caches are scan CARRIES, updated in place per layer at a dynamic
       layer index — NOT scan xs/ys, which would restack (read+write) the full

@@ -175,7 +175,9 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
         return fwd(p, rope=rope, tokens=tokens, k_cache=kc, v_cache=vc,
                    start_pos=start_pos)
 
-    sharded = jax.shard_map(
+    from ..compat import shard_map
+
+    sharded = shard_map(
         step, mesh=mesh,
         in_specs=(param_specs, P(), P(), tok_spec, kv_spec, kv_spec, pos_spec),
         out_specs=(tok_spec, kv_spec, kv_spec),

@@ -10,13 +10,31 @@ each KV-cache row advances at its own position (continuous batching).
 
 Design:
 - B cache "slots", each holding one sequence's KV rows + host-side state.
-- One scheduler thread owns the device: it alternates chunked prefill (one slot at a
-  time — prefill briefly stalls decode, the standard continuous-batching trade) with
-  batched T=1 decode steps for every active slot.
+- One scheduler thread owns the device. The decode hot path is a K-step
+  SUPER-STEP (runtime/device_loop.py make_batched_decode_loop): forward +
+  sampling scan K steps entirely on device and the host gets a (K, B) token
+  block back in ONE transfer — 1 host sync per K decoded tokens instead of 1
+  per token. K adapts: when a new request is waiting (or a row is within K of
+  finishing) the scheduler falls back to single T=1 batched steps so admission
+  latency stays bounded by one step, not K.
+- Prefill never stalls decode: a prefill chunk dispatches TOGETHER with the
+  active decode rows in one mixed (B, chunk) step — the prefill row carries
+  chunk real tokens, each decode row carries its next token at index 0 (its
+  remaining positions are scratch writes on masked future slots), and each
+  decode row's logits read from index 0. One dispatch advances the prefill
+  chunk AND every active sequence by one token.
 - Idle rows ride along with their start_pos parked at their current position: their
   cache writes land at future positions that are masked now and overwritten when those
   positions actually decode, so no masking program is needed.
-- Sampling/EOS stay on the host per row (reference Sampler semantics).
+- EOS/stop detection stays host-side, applied to the returned token block; a
+  row that stops mid-block simply keeps its position at the verified frontier
+  (the over-decoded rows beyond it sit on masked slots and are overwritten by
+  the slot's next writes — the same free-rollback property speculative
+  decoding relies on).
+- Sampling runs ON DEVICE inside the super-step with the host Sampler's
+  xorshift* stream (state uploaded before, written back after), host-side
+  elsewhere (prefill boundaries, single-step mode). Greedy super-steps emit
+  bit-exactly the host loop's tokens.
 - Per-slot NaiveCache prefix reuse (dllama-api.cpp:187-232): a new request lands on the
   free slot sharing the longest token prefix and rewinds instead of re-prefilling.
 """
@@ -74,6 +92,9 @@ class _Slot:
         self.pending: list[int] = []  # prompt tokens not yet prefilled
         self.last_token = 0  # feeds the next decode step
         self.last_logits: np.ndarray | None = None
+        # token already sampled (on device, tail of a super-step block) but not
+        # yet ingested — consumed by _advance_row instead of a host sample
+        self.next_token: int | None = None
 
 
 class BatchEngine:
@@ -85,10 +106,11 @@ class BatchEngine:
     """
 
     def __init__(self, spec: ModelSpec, params, tokenizer=None, *, slots: int = 2,
-                 **engine_kw):
+                 superstep: int = 8, **engine_kw):
         from .engine import Engine
 
         assert slots >= 1
+        assert superstep >= 1
         assert engine_kw.get("sp", 1) in (None, 1), (
             "continuous batching needs per-row cache positions, which the "
             "sequence-sharded (ring) cache does not support")
@@ -105,6 +127,7 @@ class BatchEngine:
                   flush=True)
         self.spec = spec
         self.tokenizer = tokenizer
+        self.superstep = superstep  # K: decode steps fused per device dispatch
         self._slots = [_Slot(i) for i in range(slots)]
         self._queue: "queue.Queue[BatchRequest]" = queue.Queue()
         # overflow requests with no free slot; guarded by _plock (close() may run while
@@ -113,6 +136,9 @@ class BatchEngine:
         self._plock = threading.Lock()
         self.prefilled_tokens = 0  # observability: total tokens run through prefill
         self.decode_steps = 0  # observability: batched device decode dispatches
+        self.super_steps = 0  # observability: K-step fused dispatches (subset)
+        self.mixed_steps = 0  # observability: prefill dispatches carrying decode rows
+        self._loops: dict[tuple, object] = {}  # (k, mode, window) -> batched loop
         self._wake = threading.Event()
         self._shutdown = False
         self._thread: threading.Thread | None = None
@@ -121,7 +147,7 @@ class BatchEngine:
     @classmethod
     def load(cls, model_path: str, tokenizer_path: str | None = None, *,
              max_seq_len: int = 0, weights_ftype=None, slots: int = 2,
-             **kw) -> "BatchEngine":
+             superstep: int = 8, **kw) -> "BatchEngine":
         """Engine.load-compatible constructor (same flag surface, same vocab check)."""
         from ..formats.mfile import load_model
         from ..tokenizer.bpe import Tokenizer
@@ -131,7 +157,7 @@ class BatchEngine:
         if tokenizer is not None and tokenizer.vocab_size != spec.vocab_size:
             raise ValueError(
                 f"tokenizer vocab {tokenizer.vocab_size} != model vocab {spec.vocab_size}")
-        return cls(spec, params, tokenizer, slots=slots, **kw)
+        return cls(spec, params, tokenizer, slots=slots, superstep=superstep, **kw)
 
     # ------------------------------------------------------------------
     # public API
@@ -214,6 +240,7 @@ class BatchEngine:
         best.history = best.history[:reuse]
         best.pending = req.prompt[reuse:]
         best.last_logits = None
+        best.next_token = None
         req.stats.prompt_tokens = len(req.prompt)
         return best
 
@@ -233,6 +260,7 @@ class BatchEngine:
         req.finish = finish
         slot.req = None
         slot.pending = []
+        slot.next_token = None
         req.done.set()
 
     def _park_positions(self, t: int) -> list[int]:
@@ -279,7 +307,9 @@ class BatchEngine:
             active = [s for s in self._slots if s.req and not s.pending]
             try:
                 if prefill:
-                    self._prefill_step(prefill[0])
+                    # mixed step: active decode rows ride the prefill dispatch
+                    # at T=1 instead of stalling behind it
+                    self._prefill_step(prefill[0], riders=active)
                 elif active:
                     self._decode_step(active)
                 else:
@@ -292,7 +322,59 @@ class BatchEngine:
                         self._finish(s, "error")
                 time.sleep(0.01)
 
-    def _prefill_step(self, slot: _Slot) -> None:
+    def _emit(self, slot: _Slot, token: int) -> bool:
+        """Deliver one sampled token to the request (output list, stats,
+        on_token stream) and run the host-side finish checks. Returns False
+        when the request finished (slot released). slot.pos must already count
+        the ingestion of this token's input."""
+        req = slot.req
+        req.out.append(token)
+        req.stats.generated_tokens += 1
+        if req.on_token is not None:
+            req.on_token(token)
+        if req.stop_check is not None and req.stop_check(token):
+            self._finish(slot, "stop")
+            return False
+        if len(req.out) >= req.max_tokens or slot.pos >= self.spec.seq_len:
+            self._finish(slot, "length")
+            return False
+        return True
+
+    def _advance_row(self, slot: _Slot) -> bool:
+        """Ensure slot.last_token holds the row's next un-ingested token —
+        either the device-sampled tail of the previous super-step block, or a
+        fresh host-side sample from last_logits (with delivery + finish
+        checks). Returns False when the request finished instead."""
+        req = slot.req
+        if req.cancelled:
+            self._finish(slot, "cancelled")
+            return False
+        if slot.next_token is not None:  # sampled on device, already delivered
+            slot.last_token = slot.next_token
+            slot.next_token = None
+            return True
+        if slot.last_logits is None:  # context end hit during prefill
+            self._finish(slot, "length")
+            return False
+        if req.max_tokens <= 0:  # parity with Engine.generate: zero-token request
+            self._finish(slot, "length")
+            return False
+        try:
+            token = req.sampler.sample(slot.last_logits)
+            alive = self._emit(slot, token)
+        except Exception as e:
+            # a broken callback (e.g. client disconnect mid-stream) fails ONLY
+            # this request; the other slots keep decoding
+            req.error = e
+            self._finish(slot, "error")
+            return False
+        if not alive:
+            return False
+        slot.last_token = token
+        slot.last_logits = None
+        return True
+
+    def _prefill_step(self, slot: _Slot, riders: list[_Slot] = ()) -> None:
         import time
 
         t0 = time.perf_counter()
@@ -302,6 +384,10 @@ class BatchEngine:
             slot.last_logits = None
             slot.pending = []
             return
+        # mixed prefill+decode: each active decode row rides this dispatch with
+        # its next token at index 0 (rows advance one token per prefill chunk
+        # instead of stalling behind it)
+        riders = [r for r in riders if self._advance_row(r)]
         chunk = next((c for c in PREFILL_CHUNKS if len(slot.pending) >= c), 1)
         chunk = min(chunk, room)
         # keep parked rows' scratch writes inside the cache without touching history:
@@ -312,63 +398,62 @@ class BatchEngine:
             if other is not slot and other.req is not None:
                 chunk = min(chunk, max(s - other.pos, 1))
         piece = slot.pending[:chunk]
-        starts = self._park_positions(len(piece))
+        t = len(piece)
+        starts = self._park_positions(t)
         starts[slot.index] = slot.pos
-        rows = [[tok for tok in ([0] * len(piece))] for _ in self._slots]
+        rows = [[0] * t for _ in self._slots]
         rows[slot.index] = piece
-        logits = self._step(rows, starts, len(piece))
-        self.prefilled_tokens += len(piece)
-        slot.pos += len(piece)
+        for r in riders:
+            # real token at index 0, scratch beyond: the rider's positions
+            # pos+1..pos+t-1 are masked future slots its own later decodes
+            # overwrite (in-bounds by the chunk shrink above)
+            starts[r.index] = r.pos
+            rows[r.index] = [r.last_token] + [0] * (t - 1)
+        logits = self._step(rows, starts, t)
+        if riders:
+            self.mixed_steps += 1
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self.prefilled_tokens += t
+        slot.pos += t
         slot.history.extend(piece)
-        slot.pending = slot.pending[len(piece):]
+        slot.pending = slot.pending[t:]
         if not slot.pending:
             slot.last_logits = logits[slot.index, -1]
             slot.last_token = slot.history[-1]
-        slot.req.stats.prefill_ms += (time.perf_counter() - t0) * 1000.0
+        slot.req.stats.prefill_ms += dt_ms
+        for r in riders:  # each rider decoded one token in this dispatch
+            r.last_logits = logits[r.index, 0]
+            r.history.append(r.last_token)
+            r.pos += 1
+            r.req.stats.token_ms.append(dt_ms)
+            r.req.stats.infer_ms.append(dt_ms)
 
     def _decode_step(self, active: list[_Slot]) -> None:
         import time
 
-        # sample the next token for every active row from its last logits
+        # bring every row to its next un-ingested token (host-samples rows at a
+        # prefill/single-step boundary; consumes the device-sampled tail after
+        # a super-step)
         for slot in active[:]:
-            req = slot.req
-            if req.cancelled:
-                self._finish(slot, "cancelled")
+            if not self._advance_row(slot):
                 active.remove(slot)
-                continue
-            if slot.last_logits is None:  # context end hit during prefill
-                self._finish(slot, "length")
-                active.remove(slot)
-                continue
-            if req.max_tokens <= 0:  # parity with Engine.generate: zero-token request
-                self._finish(slot, "length")
-                active.remove(slot)
-                continue
-            try:
-                token = req.sampler.sample(slot.last_logits)
-                req.out.append(token)
-                req.stats.generated_tokens += 1
-                if req.on_token is not None:
-                    req.on_token(token)
-                stopped = req.stop_check is not None and req.stop_check(token)
-            except Exception as e:
-                # a broken callback (e.g. client disconnect mid-stream) fails ONLY
-                # this request; the other slots keep decoding
-                req.error = e
-                self._finish(slot, "error")
-                active.remove(slot)
-                continue
-            if stopped:
-                self._finish(slot, "stop")
-                active.remove(slot)
-                continue
-            if len(req.out) >= req.max_tokens or slot.pos >= self.spec.seq_len:
-                self._finish(slot, "length")
-                active.remove(slot)
-                continue
-            slot.last_token = token
         if not active:
             return
+        k = self.superstep
+        if k > 1:
+            with self._plock:
+                waiting = bool(self._pending) or not self._queue.empty()
+            if not waiting:
+                # per-row step budget: stop advancing at max_tokens / context
+                # end (the row parks for the rest of the scan)
+                budgets = {
+                    slot.index: min(k, slot.req.max_tokens - len(slot.req.out),
+                                    self.spec.seq_len - slot.pos)
+                    for slot in active}
+                if max(budgets.values()) >= 2:
+                    self._super_step(active, k, budgets)
+                    return
+        # single batched T=1 step: the admission-latency (and tail) path
         t0 = time.perf_counter()
         starts = self._park_positions(1)
         rows = [[0]] * self.slots_n
@@ -384,3 +469,120 @@ class BatchEngine:
             slot.pos += 1
             slot.req.stats.token_ms.append(dt_ms)
             slot.req.stats.infer_ms.append(dt_ms)
+
+    def _batched_loop(self, k: int, mode: str, window: int | None):
+        """Compiled K-step batched device loop for this engine's config
+        (one program per (k, mode, window-bucket), memoized)."""
+        key = (k, mode, window)
+        if key not in self._loops:
+            from .device_loop import make_batched_decode_loop
+
+            eng = self._eng
+            self._loops[key] = make_batched_decode_loop(
+                self.spec, eng.mesh, eng.params, k, mode=mode, dtype=eng.dtype,
+                use_pallas=eng.use_pallas,
+                compress_collectives=eng.compress, donate_cache=True,
+                attn_window=window, cache_write=eng.cache_write,
+                moe_sharding=eng.moe_sharding,
+                fused_prologue=eng.fused_prologue)
+        return self._loops[key]
+
+    def _super_step(self, active: list[_Slot], k: int,
+                    budgets: dict[int, int]) -> None:
+        """One K-step fused dispatch: every active row decodes up to its budget
+        on device (sampling included), then the returned (K, B) block is
+        delivered host-side with EOS/stop/max checks per token. A row that
+        stops mid-block keeps its position at the verified frontier — the
+        over-decoded rows beyond it sit on masked slots and are overwritten by
+        the slot's next real writes (free rollback)."""
+        import time
+
+        t0 = time.perf_counter()
+        eng = self._eng
+        s = self.spec.seq_len
+        starts = self._park_positions(1)
+        tokens = [0] * self.slots_n
+        budget = [0] * self.slots_n
+        temps = [0.0] * self.slots_n
+        topps = [0.9] * self.slots_n
+        rng = np.zeros((self.slots_n, 2), np.uint32)
+        greedy = True
+        for slot in active:
+            i = slot.index
+            starts[i] = slot.pos
+            tokens[i] = slot.last_token
+            budget[i] = budgets[i]
+            smp = slot.req.sampler
+            temps[i] = float(getattr(smp, "temperature", 0.0))
+            topps[i] = float(getattr(smp, "topp", 0.9))
+            state = int(getattr(smp, "state", 0)) & ((1 << 64) - 1)
+            rng[i] = state >> 32, state & 0xFFFFFFFF
+            greedy = greedy and temps[i] == 0.0
+        mode = "greedy" if greedy else "sample"
+        window = eng._window_for(max(st + max(b, 1)
+                                     for st, b in zip(starts, budget)))
+        loop = self._batched_loop(k, mode, window)
+        toks, rng_out, eng.k_cache, eng.v_cache = loop(
+            eng.params, eng.rope, tokens, eng.k_cache, eng.v_cache, starts,
+            rng, temps, topps, budget)
+        toks = np.asarray(toks)  # (k, B)
+        rng_out = np.asarray(rng_out)
+        self.decode_steps += 1
+        self.super_steps += 1
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        for slot in active:
+            req = slot.req
+            i = slot.index
+            b = budget[i]
+            block = toks[:b, i].tolist()
+            smp = req.sampler
+            state0 = int(getattr(smp, "state", 0))
+            per_tok = dt_ms / b
+            x = slot.last_token  # ingested input of the block's first step
+            alive = True
+            delivered = 0  # block tokens actually handed to the request
+            try:
+                for tok in block:
+                    if req.cancelled:
+                        self._finish(slot, "cancelled")
+                        alive = False
+                        break
+                    slot.history.append(x)
+                    slot.pos += 1  # pos counts ingestions through this token's input
+                    req.stats.token_ms.append(per_tok)
+                    req.stats.infer_ms.append(per_tok)
+                    delivered += 1
+                    if not self._emit(slot, tok):
+                        alive = False
+                        break
+                    x = tok
+            except Exception as e:
+                req.error = e
+                self._finish(slot, "error")
+                alive = False
+            if temps[i] != 0.0 and hasattr(smp, "state"):
+                # resync the host sampler to the coins actually DELIVERED, not
+                # the full budget the device drew: a stop/cancel mid-block
+                # discards the tail, and the sequential stream never draws for
+                # discarded tokens (a caller-owned sampler reused across
+                # requests must see one unbroken sequence). For a fully
+                # delivered block this equals the device's returned state.
+                if alive and delivered == b:
+                    smp.state = np.uint64((int(rng_out[i, 0]) << 32)
+                                          | int(rng_out[i, 1]))
+                else:
+                    from .sampler import _random_u32
+
+                    s64 = np.uint64(state0)
+                    for _ in range(delivered):
+                        s64, _ = _random_u32(s64)
+                    smp.state = s64
+            if alive:
+                # block fully delivered; its tail is sampled but not ingested
+                slot.next_token = block[-1]
+                slot.last_logits = None
+            if b < k and starts[i] + b >= s:
+                # the row parked mid-scan at the clamped position s-1, so its
+                # scratch write destroyed that history row (mirror of the
+                # _park_positions clamp truncation)
+                slot.history = slot.history[:s - 1]

@@ -6,6 +6,13 @@ a CPU-runtime artifact; the TPU-native shape of the loop is a `lax.scan` over de
 steps *inside* the jitted SPMD program — the sampled token feeds the next embedding
 lookup on device, and the host gets a chunk of tokens back per dispatch instead of one.
 
+Two loops live here: make_decode_loop (B=1, the --device-loop CLI path) and
+make_batched_decode_loop (per-row positions/budgets/RNG — the BatchEngine's
+K-step super-step; docs/SERVING.md). The batched loop samples with the host
+Sampler's own xorshift* generator (implemented below on split uint32 halves,
+bit-exact with runtime/sampler._random_u32) so a request's sample stream stays
+one sequence across host- and device-sampled tokens.
+
 Sampling runs on device with the reference Sampler's semantics (temperature softmax,
 top-p nucleus with the (1-topp)/(n-1) pre-filter cutoff — src/tokenizer.cpp:307-415).
 Temperature 0 (greedy argmax) matches the host sampler token-for-token; stochastic
@@ -44,9 +51,14 @@ from ..parallel.sharding import kv_cache_pspec_for_mesh, param_pspecs
 from ..parallel.tp import _expand_pspec_tree
 
 
-def device_sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
-                  topp: jax.Array) -> jax.Array:
-    """Sample one token id from a (vocab,) f32 logits row, reference semantics."""
+def device_sample_coin(logits: jax.Array, u: jax.Array, temperature: jax.Array,
+                       topp: jax.Array) -> jax.Array:
+    """Sample one token id from a (vocab,) f32 logits row, reference semantics.
+
+    `u` is the uniform coin in [0, 1) — supplied by the caller so the batched
+    loop can feed the on-device xorshift* stream that mirrors the host Sampler
+    (the host draws exactly one coin per stochastic sample, so carrying the
+    xorshift* state through the scan keeps host and device state in sync)."""
     n = logits.shape[0]
 
     def greedy(_):
@@ -77,8 +89,68 @@ def device_sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
 
         return jax.lax.cond((topp > 0.0) & (topp < 1.0), nucleus, mult, u)
 
-    u = jax.random.uniform(key)
     return jax.lax.cond(temperature == 0.0, greedy, stochastic, u)
+
+
+def device_sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
+                  topp: jax.Array) -> jax.Array:
+    """device_sample_coin with the coin drawn from JAX's counter-based PRNG
+    (B=1 loop; seeds are not bit-compatible with the host xorshift* Sampler)."""
+    return device_sample_coin(logits, jax.random.uniform(key), temperature, topp)
+
+
+# ------------------------------------------------------------------
+# on-device xorshift* (the host Sampler's RNG, utils.cpp:79-90)
+# ------------------------------------------------------------------
+# The uint64 state is carried as two uint32 halves: jnp.uint64 silently
+# downcasts without jax_enable_x64, and flipping that flag globally would
+# change every f32 promotion in the model. All ops below are bit-exact with
+# runtime/sampler._random_u32, so the BatchEngine can hand a host Sampler's
+# state to the device loop and write the advanced state back afterwards.
+
+_XSM_HI = 0x2545F491  # 0x2545F4914F6CDD1D, the xorshift* multiplier
+_XSM_LO = 0x4F6CDD1D
+
+
+def _mul32_wide(a: jax.Array, b) -> tuple[jax.Array, jax.Array]:
+    """Full 32x32 -> 64-bit product as (hi32, lo32), in uint32 arithmetic."""
+    a0, a1 = a & 0xFFFF, a >> 16
+    b0, b1 = b & 0xFFFF, b >> 16
+    p00, p01, p10, p11 = a0 * b0, a0 * b1, a1 * b0, a1 * b1
+    mid = (p00 >> 16) + (p01 & 0xFFFF) + (p10 & 0xFFFF)  # < 2^18, no overflow
+    lo = (p00 & 0xFFFF) | ((mid & 0xFFFF) << 16)
+    hi = p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def _xor_shr(hi, lo, n: int):
+    """s ^ (s >> n) on a split uint64, 0 < n < 32."""
+    return hi ^ (hi >> n), lo ^ ((lo >> n) | (hi << (32 - n)))
+
+
+def _xor_shl(hi, lo, n: int):
+    """s ^ (s << n) on a split uint64, 0 < n < 32."""
+    return hi ^ ((hi << n) | (lo >> (32 - n))), lo ^ (lo << n)
+
+
+def xorshift_star_step(hi: jax.Array, lo: jax.Array):
+    """One xorshift* round; returns (hi', lo', out_u32). Vectorizes over any
+    leading shape. Bit-exact with sampler._random_u32 (same state evolution,
+    same high-32 output of the 64-bit multiply)."""
+    hi, lo = _xor_shr(hi, lo, 12)
+    hi, lo = _xor_shl(hi, lo, 25)
+    hi, lo = _xor_shr(hi, lo, 27)
+    # out = ((s * M) mod 2^64) >> 32 = hi32(lo*M_lo) + lo*M_hi + hi*M_lo (mod 2^32)
+    ph, _ = _mul32_wide(lo, jnp.uint32(_XSM_LO))
+    out = ph + lo * jnp.uint32(_XSM_HI) + hi * jnp.uint32(_XSM_LO)
+    return hi, lo, out
+
+
+def xorshift_coin(hi: jax.Array, lo: jax.Array):
+    """Advance the state and return (hi', lo', coin in [0,1) f32) — the exact
+    randomF32 mapping the host Sampler uses (utils.cpp:88-90)."""
+    hi, lo, out = xorshift_star_step(hi, lo)
+    return hi, lo, (out >> 8).astype(jnp.float32) * jnp.float32(1.0 / 16777216.0)
 
 
 def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str = "greedy",
@@ -133,7 +205,9 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
             step, (token, row0, kc, vc), jnp.arange(n_steps, dtype=jnp.int32))
         return tokens, row, kc, vc
 
-    sharded = jax.shard_map(
+    from ..compat import shard_map
+
+    sharded = shard_map(
         loop, mesh=mesh,
         in_specs=(param_specs, P(), P(), P(), kv_spec, kv_spec, P(), P(), P(), P()),
         out_specs=(P(), P(), kv_spec, kv_spec),
@@ -147,5 +221,118 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
         return jitted(p, rope.cos, rope.sin, jnp.asarray(token, jnp.int32), kc, vc,
                       jnp.int32(start_pos), key, jnp.float32(temperature),
                       jnp.float32(topp))
+
+    return run
+
+
+def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
+                             mode: str = "greedy", dtype=None,
+                             use_pallas: bool = False,
+                             compress_collectives: bool = False,
+                             donate_cache: bool = True,
+                             attn_window: int | None = None,
+                             cache_write: str = "inscan",
+                             moe_sharding: str = "slice",
+                             fused_prologue: bool = False):
+    """Batched K-step super-step: `lax.scan` over n_steps decode steps for ALL
+    cache rows at once, sampling on device — the serving-path generalization of
+    make_decode_loop (B=1) that converts the BatchEngine's hot loop from one
+    host sync per token to one per n_steps tokens.
+
+    Builds fn(params, rope, tokens (B,), kc, vc, start_pos (B,), rng (B, 2)
+    uint32 [hi, lo], temperature (B,), topp (B,), budget (B,)) ->
+    (tokens (n_steps, B), rng (B, 2), kc, vc).
+
+    Per-row carry: each row decodes at its own `start_pos` (continuous
+    batching) and stops advancing after `budget[r]` steps — a parked row keeps
+    riding the scan with its position pinned at min(pos, seq_len-1), so its
+    garbage writes land on masked slots that the row's next real token
+    overwrites (the same discipline the host scheduler's _park_positions
+    uses). The scheduler sets budget below n_steps for rows near their
+    max_tokens / context end, and 0 for empty slots.
+
+    Sampling: `mode` is static like make_decode_loop's. "sample" carries each
+    row's xorshift* state (split uint32 halves) and consumes exactly one coin
+    per live stochastic sample — bit-compatible state evolution with the host
+    Sampler, so the scheduler uploads sampler.state before the dispatch and
+    writes the returned state back after. Greedy rows (temperature 0) draw no
+    coins, matching the host.
+
+    Under dp the row axis shards over the dp mesh axis (tokens/start_pos/rng/
+    sampler params ride P(dp), like make_sharded_forward's batched step).
+    """
+    from ..parallel.mesh import AXIS_DP
+
+    assert mode in ("greedy", "sample"), mode
+    dtype = dtype or jnp.float32
+    sp = mesh.shape.get(AXIS_SP, 1)
+    dp = mesh.shape.get(AXIS_DP, 1)
+    assert sp == 1, "batched decode needs per-row cache positions (no sp ring)"
+    param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
+    kv_spec = kv_cache_pspec_for_mesh(mesh)
+    rope_type = spec.rope_type
+    seq_len = spec.seq_len
+
+    fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+                            sp_axis_name=None, sp_size=1, use_pallas=use_pallas,
+                            compress_collectives=compress_collectives,
+                            attn_window=attn_window, cache_write=cache_write,
+                            fused_prologue=fused_prologue)
+
+    def loop(p, rope_cos, rope_sin, tokens, kc, vc, start_pos, rng_hi, rng_lo,
+             temperature, topp, budget):
+        rope = RopeTables(rope_cos, rope_sin, rope_type)
+
+        def step(carry, i):
+            tok, pos, sh, sl, kc, vc = carry
+            live = i < budget  # (B,)
+            # parked rows write scratch at their current position (clamped to
+            # stay in-cache); reads mask slots >= start_pos so it is invisible,
+            # and the row's next real decode overwrites it
+            step_pos = jnp.where(live, pos, jnp.minimum(pos, seq_len - 1))
+            logits, kc, vc = fwd(p, rope=rope, tokens=tok[:, None],
+                                 k_cache=kc, v_cache=vc, start_pos=step_pos)
+            rows = logits[:, -1].astype(jnp.float32)  # (B, vocab)
+            if mode == "greedy":
+                nxt = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+            else:
+                nsh, nsl, coin = xorshift_coin(sh, sl)
+                nxt = jax.vmap(device_sample_coin)(rows, coin, temperature,
+                                                   topp)
+                drew = live & (temperature != 0.0)
+                sh = jnp.where(drew, nsh, sh)
+                sl = jnp.where(drew, nsl, sl)
+            tok = jnp.where(live, nxt, tok)
+            pos = jnp.where(live, pos + 1, pos)
+            return (tok, pos, sh, sl, kc, vc), nxt
+
+        (tok, pos, sh, sl, kc, vc), toks = jax.lax.scan(
+            step, (tokens, start_pos, rng_hi, rng_lo, kc, vc),
+            jnp.arange(n_steps, dtype=jnp.int32))
+        return toks, sh, sl, kc, vc
+
+    from ..compat import shard_map
+
+    row = P(AXIS_DP) if dp > 1 else P()
+    toks_out = P(None, AXIS_DP) if dp > 1 else P()
+    sharded = shard_map(
+        loop, mesh=mesh,
+        in_specs=(param_specs, P(), P(), row, kv_spec, kv_spec, row, row, row,
+                  row, row, row),
+        out_specs=(toks_out, row, row, kv_spec, kv_spec),
+        check_vma=False,
+    )
+    donate = (4, 5) if donate_cache else ()
+    jitted = jax.jit(sharded, donate_argnums=donate)
+
+    def run(p, rope: RopeTables, tokens, kc, vc, start_pos, rng, temperature,
+            topp, budget):
+        rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
+        toks, sh, sl, kc, vc = jitted(
+            p, rope.cos, rope.sin, jnp.asarray(tokens, jnp.int32), kc, vc,
+            jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(topp, jnp.float32), jnp.asarray(budget, jnp.int32))
+        return toks, jnp.stack([sh, sl], axis=1), kc, vc
 
     return run

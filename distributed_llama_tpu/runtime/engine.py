@@ -43,8 +43,20 @@ class GenerationStats:
     prompt_tokens: int = 0
     generated_tokens: int = 0
     prefill_ms: float = 0.0
+    # Per-token wall/device times. NOTE: when a dispatch covers several tokens
+    # (speculative verify blocks, device-loop chunks, BatchEngine super-steps)
+    # each entry is the dispatch time divided by its token count — an average,
+    # not a measured per-token latency; aggregate tokens/s stays correct, but
+    # per-token percentiles are synthetic whenever spec_steps > 0 or a
+    # multi-token loop ran. spec_step_ms keeps the real per-dispatch times.
     token_ms: list[float] = field(default_factory=list)
     infer_ms: list[float] = field(default_factory=list)
+    # speculative decoding (runtime/speculative.py): verify dispatches, draft
+    # tokens proposed/accepted, and each verify dispatch's wall time
+    spec_steps: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_step_ms: list[float] = field(default_factory=list)
     sent_kbytes_per_token: float = 0.0
     recv_kbytes_per_token: float = 0.0
     # provenance of the S/R numbers: "modeled" = the analytic formula below;

@@ -65,11 +65,20 @@ class HostKVStore:
                 # otherwise leak a multi-GB key/value.cache pair into /tmp.
                 # A caller-supplied directory is owner-kept (the reference's
                 # cache files persist too, utils.cpp:50-67).
+                # weakref.finalize, NOT atexit.register(self.cleanup): atexit
+                # would pin every disc-mode store for the process lifetime, so
+                # repeated in-process engine construction (tests, notebooks,
+                # server restarts) accumulates multi-GB cache pairs until
+                # interpreter exit. The finalizer runs at GC of the store OR
+                # at exit, whichever comes first, and holds no reference to
+                # self (only to the directory path).
+                import shutil
+                import weakref
+
                 directory = tempfile.mkdtemp(prefix="dlt_kv_cache_")
                 self._owned_dir = directory
-                import atexit
-
-                atexit.register(self.cleanup)
+                self._finalizer = weakref.finalize(
+                    self, shutil.rmtree, directory, ignore_errors=True)
             os.makedirs(directory, exist_ok=True)
             self.paths = (os.path.join(directory, "key.cache"),
                           os.path.join(directory, "value.cache"))
@@ -81,14 +90,13 @@ class HostKVStore:
 
     def cleanup(self) -> None:
         """Delete the cache file pair and its directory IF this store created
-        the directory itself (mkdtemp default). Idempotent."""
+        the directory itself (mkdtemp default). Idempotent; also detaches the
+        GC/exit finalizer so it cannot run twice."""
         if not self._owned_dir:
             return
-        import shutil
-
-        d, self._owned_dir = self._owned_dir, None
+        self._owned_dir = None
         self.k = self.v = None  # drop the memmaps before unlinking
-        shutil.rmtree(d, ignore_errors=True)
+        self._finalizer()
 
     def nbytes(self) -> int:
         return self.k.nbytes + self.v.nbytes

@@ -40,6 +40,7 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from distributed_llama_tpu.quants import QK, FloatType, QTensor  # noqa: E402
+from distributed_llama_tpu.compat import shard_map
 
 
 def fence(x):
@@ -263,8 +264,8 @@ def sec_collectives(reps):
 
     for name, fn in (("psum", psum), ("quantized_psum",
                                       lambda v, ax: quantized_psum(v, ax))):
-        g = jax.jit(jax.shard_map(lambda v: fn(v, AXIS_TP), mesh=mesh,
-                                  in_specs=P(AXIS_TP), out_specs=P(AXIS_TP)))
+        g = jax.jit(shard_map(lambda v: fn(v, AXIS_TP), mesh=mesh,
+                              in_specs=P(AXIS_TP), out_specs=P(AXIS_TP)))
         out = np.asarray(jax.device_get(g(x).addressable_shards[0].data))[0]
         rel = float(np.abs(out - want).max() / (np.abs(want).max() + 1e-9))
         dt = timed(g, x, reps=reps)

@@ -39,10 +39,14 @@ RESULT = {"metric": "llama2_7b_q40_decode_tok_s", "value": 32.35,
 def _run_bench(extra_args=(), extra_env=None):
     env = {k: v for k, v in os.environ.items()
            if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")}
-    # no axon sitecustomize, no TPU plugin: backend init fails fast and the
-    # probe path (not a wedge-hang) is exercised
+    # an unreachable backend that fails FAST: platform "tpu13" is not a
+    # registered PJRT plugin, so default_backend() raises in ~1 s. (Platform
+    # "tpu" is the wrong lever on a TPU-less host with libtpu installed: its
+    # plugin init retries GCP metadata fetches for MINUTES while holding the
+    # GIL, so even bench's own probe watchdog can't fire and every subprocess
+    # here ran into the 300 s kill — ~25 wasted minutes per tier-1 run.)
     env["PYTHONPATH"] = REPO
-    env["JAX_PLATFORMS"] = "tpu"
+    env["JAX_PLATFORMS"] = "tpu13"
     env["DLT_PROBE_TIMEOUT"] = "30"
     env["DLT_HANDOFF_PATH"] = LATEST
     env["DLT_HANDOFF_TRACKED_PATH"] = ""  # never read the repo's real mirror

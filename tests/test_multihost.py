@@ -41,8 +41,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 x = jax.device_put(
     np.arange(jax.device_count(), dtype=np.float32),
     NamedSharding(mesh, P(AXIS_TP)))
-f = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, AXIS_TP), mesh=mesh,
-                          in_specs=P(AXIS_TP), out_specs=P(AXIS_TP)))
+from distributed_llama_tpu.compat import shard_map
+f = jax.jit(shard_map(lambda v: jax.lax.psum(v, AXIS_TP), mesh=mesh,
+                      in_specs=P(AXIS_TP), out_specs=P(AXIS_TP)))
 out = f(x)
 total = float(np.asarray(jax.device_get(out.addressable_shards[0].data))[0])
 want = sum(range(jax.device_count()))
@@ -91,8 +92,13 @@ def test_two_process_pod_bootstrap(tmp_path):
             if p.poll() is None:
                 p.kill()
     joined = "\n---\n".join(outs)
+    lowered = joined.lower()
     if any(p.returncode != 0 for p in procs) and (
-            "multihost" in joined.lower() and "not implemented" in joined.lower()):
+            ("multihost" in lowered or "multiprocess" in lowered)
+            and ("not implemented" in lowered or "implemented" in lowered
+                 and "n't" in lowered)):
+        # e.g. "Multiprocess computations aren't implemented on the CPU
+        # backend" (jaxlib wording varies across versions)
         pytest.skip(f"cross-process CPU collectives unavailable: {joined[-300:]}")
     assert all(p.returncode == 0 for p in procs), joined
     assert "POD_OK process=0 devices=4" in joined, joined

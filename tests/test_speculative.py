@@ -14,7 +14,7 @@ from distributed_llama_tpu.models.spec import ArchType, ModelSpec
 from distributed_llama_tpu.quants import FloatType
 from distributed_llama_tpu.runtime.engine import Engine
 from distributed_llama_tpu.runtime.sampler import Sampler
-from distributed_llama_tpu.runtime.speculative import propose_ngram
+from distributed_llama_tpu.runtime.speculative import NgramIndex, propose_ngram
 
 SPEC = dict(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
             n_heads=4, n_kv_heads=2, vocab_size=96, seq_len=256)
@@ -56,6 +56,36 @@ def test_propose_ngram_prefers_longer_ngram():
     # at idx 0 (-> 9 too) — crafted so the 3-gram and 2-gram disagree:
     toks = [1, 2, 3, 9, 2, 3, 7, 1, 2, 3]
     assert propose_ngram(toks, 1) == [9]  # 3-gram [1,2,3] -> 9, not 2-gram -> 7
+
+
+def test_ngram_index_matches_bruteforce_incrementally():
+    """NgramIndex.propose must equal propose_ngram at EVERY append point —
+    the incremental dict replaces the O(len*max_ngram) full-history rescan
+    with O(max_ngram) lookups, answers unchanged."""
+    rs = np.random.RandomState(3)
+    toks = rs.randint(0, 6, size=400).tolist()  # small alphabet: dense matches
+    idx = NgramIndex(toks[:5])
+    for i in range(5, len(toks)):
+        for k in (1, 4, 8):
+            assert idx.propose(k) == propose_ngram(toks[:i], k), (i, k)
+        idx.append(toks[i])
+    # non-repetitive and degenerate corpora too
+    idx = NgramIndex([])
+    assert idx.propose(4) == propose_ngram([], 4) == []
+    for i, t in enumerate(range(50, 90)):
+        idx.append(t)
+        assert idx.propose(4) == propose_ngram(list(range(50, 51 + i)), 4)
+
+
+def test_ngram_index_seeded_corpus_matches_bruteforce():
+    """Constructor-seeded corpus (the history_tokens path) behaves like
+    append-built."""
+    toks = [3, 7, 11] * 10 + [5, 3, 7]
+    a = NgramIndex(list(toks))
+    b = NgramIndex([])
+    b.extend(toks)
+    for k in (1, 3, 8):
+        assert a.propose(k) == b.propose(k) == propose_ngram(toks, k)
 
 
 # ------------------------------------------------------------- exactness
