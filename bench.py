@@ -55,6 +55,7 @@ from distributed_llama_tpu.ops.rope import RopeTables  # noqa: E402
 from distributed_llama_tpu.parallel.mesh import make_mesh  # noqa: E402
 from distributed_llama_tpu.parallel.tp import (  # noqa: E402
     init_sharded_kv_cache, make_sharded_forward, shard_params)
+from distributed_llama_tpu.obs import trace as obs_trace  # noqa: E402
 from distributed_llama_tpu.ops.pallas_prologue import (  # noqa: E402
     prologue_supported)
 from distributed_llama_tpu.quants import QK, FloatType, QTensor  # noqa: E402
@@ -391,6 +392,10 @@ def main():
                          "of decode")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed region here")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="record per-dispatch spans of the timed region and "
+                         "write Chrome trace-event JSON (obs/trace.py; open "
+                         "in ui.perfetto.dev)")
     ap.add_argument("--no-fuse", action="store_true",
                     help="keep wq/wk/wv and w1/w3 as separate kernel launches "
                          "instead of the merged wqkv/w13 groups (A/B lever)")
@@ -409,6 +414,17 @@ def main():
                          "XLA dequant path — opt-in until the hardware A/B lands")
     args = ap.parse_args()
 
+    if args.trace:
+        # NOTE: obs_trace is the MODULE-level import — a local re-import here
+        # would make the name local to main() and crash every non---trace run
+        # at the span sites (the make_sharded_forward shadowing bug's twin)
+        tracer = obs_trace.install()
+        import atexit
+
+        # normal exits only — _exit_now (wedged-tunnel escape) skips atexit
+        # by design, and a trace of a wedged run would be empty anyway
+        atexit.register(lambda: tracer.dump(args.trace))
+
     # headline = every semantics-bearing flag at its parser default (derived,
     # not duplicated, so a default change can't silently desync the gate;
     # --steps only changes averaging, not what is measured) AND no
@@ -418,7 +434,7 @@ def main():
         getattr(args, k) == ap.get_default(k)
         for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
                   "window", "cache_write", "no_fuse", "prologue",
-                  "prefill_kernel", "kv_paged", "batch", "superstep")
+                  "prefill_kernel", "kv_paged", "batch", "superstep", "trace")
     ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
     if args.batch > 0 and (args.prefill > 0 or args.device_loop > 0
                            or args.kv_paged > 0):
@@ -562,8 +578,10 @@ def main():
         # the timed region measures exactly what a user of
         # --kv-cache-storage host pays per token. No fallback ladder — a
         # lowering failure here is an explicit error record, not a downgrade.
-        from distributed_llama_tpu.parallel.tp import (  # noqa: E402
-            make_sharded_forward)
+        # NOTE: make_sharded_forward comes from the MODULE-level import; a
+        # function-local re-import here made it a local name of main() and
+        # broke every non-paged bench path with an unbound-free-variable
+        # NameError (the shadowing bug the smoke-lint satellite exists for).
         from distributed_llama_tpu.runtime.paged_cache import (  # noqa: E402
             HostKVStore, init_ring_cache, make_paged_step)
 
@@ -821,9 +839,10 @@ def main():
         with profile_ctx:
             t0 = time.perf_counter()
             for _ in range(n_disp):
-                toks, _, kc, vc = loop(params, rope, ones_tok, kc, vc,
-                                       np.full((B,), pos, np.int32), rng,
-                                       zeros, zeros + 0.9, full_budget)
+                with obs_trace.span("bench.super_step", {"B": B, "K": K}):
+                    toks, _, kc, vc = loop(params, rope, ones_tok, kc, vc,
+                                           np.full((B,), pos, np.int32), rng,
+                                           zeros, zeros + 0.9, full_budget)
                 pos += K
             np.asarray(toks)
             dt_disp = (time.perf_counter() - t0) / n_disp
@@ -897,7 +916,9 @@ def main():
             t0 = time.perf_counter()
             pos = 4
             for _ in range(args.steps):
-                logits, kc, vc = step(params, rope, tok, kc, vc, jnp.int32(pos))
+                with obs_trace.span("bench.decode_step", {"pos": pos}):
+                    logits, kc, vc = step(params, rope, tok, kc, vc,
+                                          jnp.int32(pos))
                 pos += 1
             np.asarray(logits[0, 0, 0])
             dt = (time.perf_counter() - t0) / args.steps

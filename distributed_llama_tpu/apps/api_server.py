@@ -22,10 +22,35 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..obs import metrics
 from ..runtime.engine import Engine
 from ..runtime.sampler import Sampler
 from ..tokenizer import ChatItem, ChatTemplate, EosDetector, TemplateType
 from ..tokenizer.eos import TokenStreamer
+
+# Per-request serving latencies (docs/OBSERVABILITY.md). TTFT is request
+# arrival to the first text delta (the user-visible number: prefill + queue
+# wait + first decode); TPOT the mean inter-token time after it; E2E the
+# whole completion.
+_TTFT = metrics.histogram(
+    "api_request_ttft_seconds", "Request arrival to first streamed text delta")
+_TPOT = metrics.histogram(
+    "api_request_tpot_seconds",
+    "Mean per-token time after the first token, per request")
+_E2E = metrics.histogram(
+    "api_request_e2e_seconds", "Request arrival to completion")
+_HTTP = metrics.counter(
+    "api_http_requests_total", "HTTP requests by route and status code",
+    labelnames=("route", "code"))
+
+_KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
+                 "/v1/stats", "/metrics", "/health", "/healthz")
+
+
+def _count_http(path: str, code: int) -> None:
+    # unknown paths collapse to one label value so scrapes stay bounded
+    route = path if path in _KNOWN_ROUTES else "other"
+    _HTTP.labels(route=route, code=str(code)).inc()
 
 
 class NaiveCache:
@@ -94,14 +119,55 @@ def _chunk_payload(state: ApiState, completion_id: str, delta: dict,
     }
 
 
+def _stats_payload(state: "ApiState") -> dict:
+    """GET /v1/stats: one JSON snapshot of every metric plus scheduler/engine
+    state — the same numbers as /metrics, shaped for humans and scripts
+    rather than a Prometheus scraper."""
+    out: dict = {"model": state.model_name, "time": _now(),
+                 "metrics": metrics.snapshot()}
+    be = state.batch_engine
+    if be is not None:
+        out["batch_engine"] = {
+            "slots": be.slots_n, "superstep": be.superstep,
+            "prefilled_tokens": be.prefilled_tokens,
+            "decode_steps": be.decode_steps,
+            "super_steps": be.super_steps,
+            "mixed_steps": be.mixed_steps,
+            "occupied": sum(1 for s in be._slots if s.req is not None),
+        }
+    elif state.engine is not None:
+        eng = state.engine
+        out["engine"] = {"pos": eng.pos, "tp": eng.tp, "sp": eng.sp,
+                         "paged": eng.paged,
+                         "seq_len": eng.spec.seq_len}
+    return out
+
+
 def _opt(body: dict, key: str, default):
     """Request override with OpenAI null semantics: explicit null == unset."""
     v = body.get(key)
     return default if v is None else v
 
 
+def _observe_done(t_start: float, ttft: list, n_tokens: int) -> None:
+    dt = time.perf_counter() - t_start
+    _E2E.observe(dt)
+    if ttft[0] is not None and n_tokens > 1:
+        _TPOT.observe((dt - ttft[0]) / (n_tokens - 1))
+
+
 def run_completion(state: ApiState, body: dict, emit):
     """Shared completion core. `emit(text_delta)` streams; returns (text, finish)."""
+    t_start = time.perf_counter()
+    ttft: list = [None]
+    user_emit = emit
+
+    def emit(text):
+        if ttft[0] is None:
+            ttft[0] = time.perf_counter() - t_start
+            _TTFT.observe(ttft[0])
+        user_emit(text)
+
     runner = state.batch_engine or state.engine
     tok = runner.tokenizer
     spec = runner.spec
@@ -163,6 +229,7 @@ def run_completion(state: ApiState, body: dict, emit):
             raise req.error
         if qstreamer.stopped:
             finish[0] = "stop"
+        _observe_done(t_start, ttft, req.stats.generated_tokens)
         return "".join(pieces), finish[0]
 
     engine = state.engine
@@ -201,6 +268,7 @@ def run_completion(state: ApiState, body: dict, emit):
     # only tokens whose KV was actually written are reusable (a final stop token is
     # sampled but never inferred, so engine.pos may be one short of prompt+out)
     state.cache.update((prompt + out)[: engine.pos])
+    _observe_done(t_start, ttft, len(out))
     return "".join(pieces), finish[0]
 
 
@@ -210,13 +278,21 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quieter logs, reference prints per request
         print(f"🔷 {self.command} {self.path}")
 
-    def _json(self, code: int, payload: dict):
-        data = json.dumps(payload).encode()
+    def _raw(self, code: int, content_type: str, data: bytes):
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+        _count_http(self.path, code)
+
+    def _json(self, code: int, payload: dict):
+        self._raw(code, "application/json", json.dumps(payload).encode())
+
+    def _error(self, code: int, message: str, etype: str):
+        """OpenAI-style error body: {"error": {"message", "type"}} — clients
+        built against the OpenAI SDK parse this shape, not bare strings."""
+        self._json(code, {"error": {"message": message, "type": etype}})
 
     def do_GET(self):
         if self.path == "/v1/models":
@@ -224,22 +300,31 @@ class Handler(BaseHTTPRequestHandler):
                 {"id": self.state.model_name, "object": "model",
                  "created": _now(), "owned_by": "user"}]})
         elif self.path in ("/health", "/healthz"):
+            # load-balancer probe: cheap, no device work, 200 iff the process
+            # is serving (scheduler liveness is visible in /metrics instead)
             self._json(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            self._raw(200, "text/plain; version=0.0.4; charset=utf-8",
+                      metrics.render().encode())
+        elif self.path == "/v1/stats":
+            self._json(200, _stats_payload(self.state))
         else:
-            self._json(404, {"error": "not found"})
+            self._error(404, f"Unknown route: {self.path}", "invalid_request_error")
 
     def do_POST(self):
         if self.path not in ("/v1/chat/completions", "/chat/completions"):
-            self._json(404, {"error": "not found"})
+            self._error(404, f"Unknown route: {self.path}", "invalid_request_error")
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
         except (ValueError, json.JSONDecodeError):
-            self._json(400, {"error": "invalid JSON body"})
+            self._error(400, "Request body is not valid JSON",
+                        "invalid_request_error")
             return
         if not isinstance(body.get("messages"), list) or not body["messages"]:
-            self._json(400, {"error": "messages[] required"})
+            self._error(400, "'messages' must be a non-empty array",
+                        "invalid_request_error")
             return
         stream = bool(body.get("stream", False))
         state = self.state
@@ -254,6 +339,7 @@ class Handler(BaseHTTPRequestHandler):
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                _count_http(self.path, 200)
                 completion_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
 
                 def emit(text):
@@ -268,7 +354,9 @@ class Handler(BaseHTTPRequestHandler):
                          + "\n\n").encode())
                 except Exception as e:  # headers already sent: error as SSE event
                     self._write_chunk(
-                        f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+                        ("data: " + json.dumps({"error": {
+                            "message": str(e), "type": "server_error"}})
+                         + "\n\n").encode())
                 finally:
                     # always terminate the chunked stream so clients don't hang
                     self._write_chunk(b"data: [DONE]\n\n")
@@ -278,9 +366,9 @@ class Handler(BaseHTTPRequestHandler):
                     text, finish = run_completion(state, body, lambda _t: None)
                     self._json(200, _completion_payload(state, text, finish))
                 except ValueError as e:
-                    self._json(400, {"error": str(e)})
+                    self._error(400, str(e), "invalid_request_error")
                 except Exception as e:
-                    self._json(500, {"error": str(e)})
+                    self._error(500, str(e), "server_error")
 
     def _write_chunk(self, data: bytes):
         self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
@@ -331,6 +419,9 @@ def main(argv=None) -> None:
                    help="data-parallel mesh axis: shard the --batch cache rows over "
                         "N device groups (requires --batch divisible by N)")
     args = p.parse_args(argv)
+    from .dllama import dump_trace, install_trace
+
+    install_trace(args)
     batch_engine = None
     if args.dp > 1 and args.batch <= 1:
         p.error("--dp requires --batch > 1 (data parallelism shards batched cache rows)")
@@ -380,7 +471,12 @@ def main(argv=None) -> None:
                    TemplateType(args.chat_template) if args.chat_template
                    else TemplateType.UNKNOWN, sampler, args.device_loop,
                    batch_engine=batch_engine, speculative_k=args.speculative)
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        dump_trace(args)  # --trace: flush the span buffer on shutdown
 
 
 if __name__ == "__main__":

@@ -98,6 +98,16 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                         "one step (runtime/speculative.py). Greedy-only "
                         "(temperature 0); emits exactly the sequential loop's "
                         "tokens. No reference counterpart")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="record runtime spans (prefill chunks, decode "
+                        "dispatches, super-steps, cold-attention callbacks) "
+                        "and write a Chrome trace-event JSON at exit — load "
+                        "it in Perfetto (ui.perfetto.dev) or chrome://tracing "
+                        "(obs/trace.py; docs/OBSERVABILITY.md)")
+    p.add_argument("--trace-annotate", action="store_true",
+                   help="with --trace: also forward each span as a "
+                        "jax.profiler TraceAnnotation so spans appear inside "
+                        "an XLA device trace (perf/PROFILE.md workflow)")
     p.add_argument("--nthreads", type=int, default=None, help="ignored (XLA owns the chip)")
     p.add_argument("--kv-cache-storage", default=None,
                    choices=["ram", "host", "disc"],
@@ -137,6 +147,31 @@ def check_kv_storage(args) -> None:
 
 _FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
        "q80": FloatType.Q80}
+
+
+def install_trace(args) -> bool:
+    """--trace bootstrap (shared by dllama and api_server): install the
+    process-wide tracer before any engine work so model-load/compile spans
+    are captured too. Returns True when tracing is on."""
+    if not getattr(args, "trace", None):
+        return False
+    from ..obs import trace
+
+    trace.install(jax_annotations=getattr(args, "trace_annotate", False))
+    return True
+
+
+def dump_trace(args) -> None:
+    """Write the Chrome trace to args.trace (no-op when --trace is unset)."""
+    from ..obs import trace
+
+    t = trace.current()
+    if getattr(args, "trace", None) and t is not None:
+        t.dump(args.trace)
+        n = len(t.events())
+        print(f"🧭 wrote {n} trace events to {args.trace} "
+              f"({t.dropped_events} dropped) — open in ui.perfetto.dev",
+              file=sys.stderr)
 
 
 def init_pod(args) -> int:
@@ -321,7 +356,12 @@ def main(argv=None) -> None:
     apply_platform_env()
     args = build_parser().parse_args(argv)
     check_kv_storage(args)
-    {"inference": mode_inference, "generate": mode_generate, "chat": mode_chat}[args.mode](args)
+    install_trace(args)
+    try:
+        {"inference": mode_inference, "generate": mode_generate,
+         "chat": mode_chat}[args.mode](args)
+    finally:
+        dump_trace(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,21 @@
+"""Observability: structured span tracing + Prometheus-style metrics.
+
+Two sibling modules, both dependency-free and safe to import from any layer:
+
+- `obs.trace`  — thread-safe span tracer with Chrome trace-event JSON export
+  (Perfetto-loadable); process-wide no-op until `trace.install()` runs
+  (`dllama --trace out.json`, `bench.py --trace`).
+- `obs.metrics` — counters / gauges / histograms with Prometheus text
+  exposition, served by `api_server` at `GET /metrics` (and as a JSON
+  snapshot at `GET /v1/stats`).
+
+The runtime (engine, batch_engine, speculative, paged_cache, hlo_stats) is
+instrumented unconditionally: metrics cost one lock + add per event and the
+disabled tracer costs one global check per span (perf/obs_overhead.py pins
+the overhead at <1% of a decode dispatch). docs/OBSERVABILITY.md has the
+full span/metric inventory.
+"""
+
+from . import metrics, trace
+
+__all__ = ["metrics", "trace"]

@@ -1,0 +1,222 @@
+"""Structured span tracing with Chrome trace-event export.
+
+The reference engine's only timeline is the per-token G/I/T printout
+(dllama.cpp:76-93); one number per token, averaged, gone when the process
+exits. This tracer records *spans* — named wall-clock intervals with nesting
+(prefill chunks inside a prefill, super-steps inside a request) — into a
+bounded in-memory ring buffer and exports them as Chrome trace-event JSON,
+loadable in Perfetto (ui.perfetto.dev) or chrome://tracing.
+
+Design constraints, in priority order:
+
+1. **Zero-cost when disabled.** Every hot path in the repo calls
+   `obs.trace.span(...)` unconditionally; when no tracer is installed the
+   call returns a shared no-op context manager (one global lookup + one
+   function call, no allocation). perf/obs_overhead.py pins this at <1% of a
+   decode dispatch.
+2. **Thread-safe.** The BatchEngine scheduler thread, HTTP handler threads,
+   and the main thread all emit spans concurrently; the buffer is a
+   lock-guarded deque and span timing state lives on the span object itself
+   (never in shared state).
+3. **Bounded.** The ring buffer drops the OLDEST events past `capacity` —
+   a long-running server never grows without bound; `dropped_events` counts
+   what was lost so an exported trace is honest about truncation.
+4. **Monotonic clocks.** Timestamps come from time.perf_counter_ns()
+   relative to tracer start; wall-clock (time.time) appears once in the
+   export metadata, so NTP steps can never fold spans over each other.
+
+Optional `jax.profiler` pass-through: with `jax_annotations=True` each span
+also enters a jax.profiler.TraceAnnotation, so the spans show up inside an
+XLA device trace (perf/PROFILE.md workflow) under the same names.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+__all__ = ["Tracer", "span", "instant", "install", "uninstall", "current"]
+
+
+class _NullSpan:
+    """Shared no-op context manager returned while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add(self, **args) -> None:  # parity with _Span.add
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: created by Tracer.span(), recorded at __exit__."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0", "_annot")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict | None):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._annot = None
+
+    def add(self, **args) -> None:
+        """Attach result metadata discovered mid-span (token counts, sizes)."""
+        if self.args is None:
+            self.args = args
+        else:
+            self.args.update(args)
+
+    def __enter__(self):
+        if self._tracer._annotate:
+            try:
+                import jax.profiler
+
+                self._annot = jax.profiler.TraceAnnotation(self.name)
+                self._annot.__enter__()
+            except Exception:
+                self._annot = None  # device trace unavailable: spans still record
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._annot is not None:
+            self._annot.__exit__(*exc)
+        self._tracer._record(self.name, self._t0, t1, self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder with a bounded ring buffer.
+
+    Spans are recorded AT EXIT as Chrome "X" (complete) events — start
+    timestamp + duration — so nesting in the viewer is purely geometric:
+    a child span's [ts, ts+dur] interval lies inside its parent's, because
+    the child entered after and exited before on the same thread.
+    """
+
+    def __init__(self, capacity: int = 65536, *, jax_annotations: bool = False):
+        assert capacity > 0
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._annotate = jax_annotations
+        self._epoch_ns = time.perf_counter_ns()
+        self._wall_start = time.time()
+        self.dropped_events = 0
+        self._thread_names: dict[int, str] = {}
+
+    # -- recording ------------------------------------------------------
+
+    def span(self, name: str, args: dict | None = None) -> _Span:
+        return _Span(self, name, args)
+
+    def instant(self, name: str, args: dict | None = None) -> None:
+        """Point-in-time marker (Chrome "i" event)."""
+        ts = (time.perf_counter_ns() - self._epoch_ns) / 1e3
+        self._append({"name": name, "ph": "i", "ts": ts, "s": "t",
+                      "pid": 1, "tid": threading.get_ident(),
+                      **({"args": args} if args else {})})
+
+    def _record(self, name: str, t0_ns: int, t1_ns: int,
+                args: dict | None) -> None:
+        ev = {"name": name, "ph": "X",
+              "ts": (t0_ns - self._epoch_ns) / 1e3,  # Chrome wants microseconds
+              "dur": (t1_ns - t0_ns) / 1e3,
+              "pid": 1, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: dict) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            if len(self._events) == self.capacity:
+                self.dropped_events += 1
+            self._events.append(ev)
+
+    # -- export ---------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        """Snapshot of buffered events (oldest first), plus thread metadata."""
+        with self._lock:
+            evs = list(self._events)
+            names = dict(self._thread_names)
+        meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                 "args": {"name": tname}} for tid, tname in sorted(names.items())]
+        return meta + evs
+
+    def to_chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON object (load in Perfetto as-is)."""
+        return {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "wall_start_unix": self._wall_start,
+                "dropped_events": self.dropped_events,
+            },
+        }
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped_events = 0
+
+
+# ----------------------------------------------------------------------
+# module-level switch: the instrumented hot paths call these directly
+# ----------------------------------------------------------------------
+
+_tracer: Tracer | None = None
+
+
+def install(capacity: int = 65536, *, jax_annotations: bool = False) -> Tracer:
+    """Enable tracing process-wide; returns the tracer (idempotent: a second
+    install replaces the first — one tracer owns the buffer at a time)."""
+    global _tracer
+    _tracer = Tracer(capacity, jax_annotations=jax_annotations)
+    return _tracer
+
+
+def uninstall() -> None:
+    global _tracer
+    _tracer = None
+
+
+def current() -> Tracer | None:
+    return _tracer
+
+
+def span(name: str, args: dict | None = None):
+    """`with span("engine.decode", {"t": 1}):` — no-op unless install()ed.
+
+    Args are passed as an optional dict (not **kwargs) so the disabled path
+    does not even build a dict per call site when the caller pre-builds
+    nothing; callers that want rich args construct the dict inline, paying
+    for it only at sites they chose to annotate."""
+    t = _tracer
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, args)
+
+
+def instant(name: str, args: dict | None = None) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, args)

@@ -187,6 +187,34 @@ def jaxpr_collective_traffic(closed_jaxpr, axis_sizes: dict[str, int]
     return out
 
 
+def publish_traffic(traffic: CollectiveTraffic, program: str) -> None:
+    """Export a program's measured collective accounting as gauges
+    (obs/metrics.py) so `GET /metrics` serves what was previously a one-off
+    bench artifact. `program` names the compiled program the numbers belong
+    to (e.g. "decode_t1") — per-program provenance is the whole point of the
+    measured path (presenting one program's trace as another's was the
+    round-1 defect)."""
+    from ..obs import metrics
+
+    metrics.gauge(
+        "collective_sent_bytes_per_device",
+        "Measured per-device ring-algorithm wire bytes per program execution",
+        labelnames=("program",)).labels(program=program).set(
+            traffic.sent_bytes_per_device)
+    payload = metrics.gauge(
+        "collective_payload_bytes",
+        "Measured collective payload bytes per program execution, by op",
+        labelnames=("program", "op"))
+    count = metrics.gauge(
+        "collective_count",
+        "Collective ops executed per program execution, by op",
+        labelnames=("program", "op"))
+    for op, b in traffic.payload_bytes.items():
+        payload.labels(program=program, op=op).set(b)
+    for op, c in traffic.counts.items():
+        count.labels(program=program, op=op).set(c)
+
+
 def collective_traffic(hlo_text: str, default_group_size: int) -> CollectiveTraffic:
     """Account every collective instruction in an (optimized) HLO module text.
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -50,9 +51,48 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.spec import ModelSpec
+from ..obs import metrics, trace
 from .engine import PREFILL_CHUNKS, GenerationStats
 
 __all__ = ["BatchEngine", "BatchRequest"]
+
+# Scheduler telemetry (docs/OBSERVABILITY.md). The super-step scheduler was a
+# black box: admission latency, dispatch mix, rollback volume, and slot
+# occupancy were all invisible outside one-off bench runs.
+_QUEUE_WAIT = metrics.histogram(
+    "batch_queue_wait_seconds",
+    "submit() to slot assignment (admission latency incl. queueing)")
+_QUEUE_DEPTH = metrics.gauge(
+    "batch_queue_depth", "Requests waiting for a free slot")
+_SLOTS_TOTAL = metrics.gauge(
+    "batch_slots_total", "Configured cache slots (--batch)")
+_SLOTS_OCCUPIED = metrics.gauge(
+    "batch_slots_occupied", "Cache slots holding a live request")
+_DISPATCH_SECONDS = metrics.histogram(
+    "batch_dispatch_seconds",
+    "Wall time of one scheduler device dispatch, by shape",
+    labelnames=("kind",))
+_DISP_PREFILL = _DISPATCH_SECONDS.labels(kind="prefill")
+_DISP_MIXED = _DISPATCH_SECONDS.labels(kind="mixed")
+_DISP_SINGLE = _DISPATCH_SECONDS.labels(kind="single_step")
+_DISP_SUPER = _DISPATCH_SECONDS.labels(kind="super_step")
+_SUPERSTEP_TOKENS = metrics.histogram(
+    "batch_superstep_tokens",
+    "Tokens decoded per super-step dispatch (sum of row budgets)",
+    buckets=metrics.DEFAULT_SIZE_BUCKETS)
+_ROLLBACK_TOKENS = metrics.counter(
+    "batch_rollback_tokens_total",
+    "Device-decoded tokens discarded by host-side stop/cancel frontier rewind")
+_PARKED_ROW_STEPS = metrics.counter(
+    "batch_parked_row_steps_total",
+    "Row-steps spent parked (rows riding a dispatch without advancing)")
+_PREFILL_TOKENS = metrics.counter(
+    "batch_prefill_tokens_total", "Prompt tokens prefilled by the scheduler")
+_DECODE_TOKENS = metrics.counter(
+    "batch_decode_tokens_total", "Tokens delivered to requests by the scheduler")
+_REQUESTS = metrics.counter(
+    "batch_requests_total", "Completed requests by finish reason",
+    labelnames=("finish",))
 
 
 @dataclass
@@ -70,6 +110,7 @@ class BatchRequest:
     stats: GenerationStats = field(default_factory=GenerationStats)
 
     cancelled: bool = False
+    submit_t: float = 0.0  # perf_counter at submit(), feeds batch_queue_wait
 
     def cancel(self) -> None:
         """Ask the scheduler to stop decoding this request (client went away)."""
@@ -139,10 +180,13 @@ class BatchEngine:
         self.super_steps = 0  # observability: K-step fused dispatches (subset)
         self.mixed_steps = 0  # observability: prefill dispatches carrying decode rows
         self._loops: dict[tuple, object] = {}  # (k, mode, window) -> batched loop
-        self._wake = threading.Event()
+        # scheduler wakeup: a Condition, not a sleep-poll — submit() notifies,
+        # so enqueue latency is bounded by lock handoff, not a poll interval
+        self._cond = threading.Condition()
         self._shutdown = False
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        _SLOTS_TOTAL.set(slots)
 
     @classmethod
     def load(cls, model_path: str, tokenizer_path: str | None = None, *,
@@ -170,9 +214,11 @@ class BatchEngine:
         req = BatchRequest(list(prompt), max_tokens, sampler, on_token, stop_check)
         if not req.prompt:
             req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
+        req.submit_t = time.perf_counter()
         self._ensure_thread()
         self._queue.put(req)
-        self._wake.set()
+        with self._cond:
+            self._cond.notify()
         return req
 
     def generate(self, prompt: list[int], max_tokens: int, sampler,
@@ -184,7 +230,8 @@ class BatchEngine:
 
     def close(self) -> None:
         self._shutdown = True
-        self._wake.set()
+        with self._cond:
+            self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
         # unblock every waiter: in-flight slots and still-queued requests. The
@@ -242,6 +289,8 @@ class BatchEngine:
         best.last_logits = None
         best.next_token = None
         req.stats.prompt_tokens = len(req.prompt)
+        if req.submit_t:
+            _QUEUE_WAIT.observe(time.perf_counter() - req.submit_t)
         return best
 
     def _step(self, tokens_rows: list[list[int]], starts: list[int], t: int):
@@ -261,6 +310,7 @@ class BatchEngine:
         slot.req = None
         slot.pending = []
         slot.next_token = None
+        _REQUESTS.labels(finish=finish).inc()
         req.done.set()
 
     def _park_positions(self, t: int) -> list[int]:
@@ -279,8 +329,6 @@ class BatchEngine:
         return starts
 
     def _loop(self) -> None:
-        import time
-
         while not self._shutdown:
             # admit queued requests onto free slots (FIFO: scheduler-local overflow
             # first, then the cross-thread queue)
@@ -294,17 +342,20 @@ class BatchEngine:
                     if self._pending[0].cancelled:
                         req = self._pending.pop(0)
                         req.finish = "cancelled"
+                        _REQUESTS.labels(finish="cancelled").inc()
                         req.done.set()
                         continue
                     if self._assign(self._pending[0]) is None:
                         break  # no free slot: serve current load first
                     self._pending.pop(0)
+                _QUEUE_DEPTH.set(len(self._pending) + self._queue.qsize())
 
             for sl in self._slots:  # a cancelled request frees its slot immediately,
                 if sl.req is not None and sl.req.cancelled:  # even mid-prefill
                     self._finish(sl, "cancelled")
             prefill = [s for s in self._slots if s.req and s.pending]
             active = [s for s in self._slots if s.req and not s.pending]
+            _SLOTS_OCCUPIED.set(sum(1 for s in self._slots if s.req is not None))
             try:
                 if prefill:
                     # mixed step: active decode rows ride the prefill dispatch
@@ -313,14 +364,23 @@ class BatchEngine:
                 elif active:
                     self._decode_step(active)
                 else:
-                    self._wake.wait(timeout=0.2)
-                    self._wake.clear()
+                    # idle: sleep on the condition until submit()/close()
+                    # notifies. The timeout is only a safety net (e.g. a
+                    # queued request cancelled while idle has no notifier);
+                    # enqueue latency is set by the notify, not this number.
+                    with self._cond:
+                        if self._queue.empty() and not self._shutdown:
+                            self._cond.wait(timeout=0.5)
             except Exception as e:  # propagate to every in-flight request
                 for s in self._slots:
                     if s.req is not None:
                         s.req.error = e
                         self._finish(s, "error")
-                time.sleep(0.01)
+                # brief condition-based backoff so a persistently failing step
+                # cannot spin the scheduler hot (a notify still wakes it early)
+                with self._cond:
+                    if not self._shutdown:
+                        self._cond.wait(timeout=0.05)
 
     def _emit(self, slot: _Slot, token: int) -> bool:
         """Deliver one sampled token to the request (output list, stats,
@@ -330,6 +390,7 @@ class BatchEngine:
         req = slot.req
         req.out.append(token)
         req.stats.generated_tokens += 1
+        _DECODE_TOKENS.inc()
         if req.on_token is not None:
             req.on_token(token)
         if req.stop_check is not None and req.stop_check(token):
@@ -375,8 +436,6 @@ class BatchEngine:
         return True
 
     def _prefill_step(self, slot: _Slot, riders: list[_Slot] = ()) -> None:
-        import time
-
         t0 = time.perf_counter()
         s = self.spec.seq_len
         room = s - slot.pos
@@ -409,10 +468,16 @@ class BatchEngine:
             # overwrite (in-bounds by the chunk shrink above)
             starts[r.index] = r.pos
             rows[r.index] = [r.last_token] + [0] * (t - 1)
-        logits = self._step(rows, starts, t)
+        with trace.span("batch.mixed_step" if riders else "batch.prefill",
+                        {"chunk": t, "riders": len(riders)}):
+            logits = self._step(rows, starts, t)
         if riders:
             self.mixed_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
+        (_DISP_MIXED if riders else _DISP_PREFILL).observe(dt_ms / 1000.0)
+        _PREFILL_TOKENS.inc(t)
+        # rows neither prefilling nor riding spent this dispatch parked
+        _PARKED_ROW_STEPS.inc(self.slots_n - 1 - len(riders))
         self.prefilled_tokens += t
         slot.pos += t
         slot.history.extend(piece)
@@ -421,16 +486,16 @@ class BatchEngine:
             slot.last_logits = logits[slot.index, -1]
             slot.last_token = slot.history[-1]
         slot.req.stats.prefill_ms += dt_ms
+        slot.req.stats.dispatch_ms.append(dt_ms)
         for r in riders:  # each rider decoded one token in this dispatch
             r.last_logits = logits[r.index, 0]
             r.history.append(r.last_token)
             r.pos += 1
             r.req.stats.token_ms.append(dt_ms)
             r.req.stats.infer_ms.append(dt_ms)
+            r.req.stats.dispatch_ms.append(dt_ms)
 
     def _decode_step(self, active: list[_Slot]) -> None:
-        import time
-
         # bring every row to its next un-ingested token (host-samples rows at a
         # prefill/single-step boundary; consumes the device-sampled tail after
         # a super-step)
@@ -460,15 +525,19 @@ class BatchEngine:
         for slot in active:
             starts[slot.index] = slot.pos
             rows[slot.index] = [slot.last_token]
-        logits = self._step(rows, starts, 1)
+        with trace.span("batch.single_step", {"rows": len(active)}):
+            logits = self._step(rows, starts, 1)
         self.decode_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
+        _DISP_SINGLE.observe(dt_ms / 1000.0)
+        _PARKED_ROW_STEPS.inc(self.slots_n - len(active))
         for slot in active:
             slot.last_logits = logits[slot.index, -1]
             slot.history.append(slot.last_token)
             slot.pos += 1
             slot.req.stats.token_ms.append(dt_ms)
             slot.req.stats.infer_ms.append(dt_ms)
+            slot.req.stats.dispatch_ms.append(dt_ms)
 
     def _batched_loop(self, k: int, mode: str, window: int | None):
         """Compiled K-step batched device loop for this engine's config
@@ -495,8 +564,6 @@ class BatchEngine:
         stops mid-block keeps its position at the verified frontier — the
         over-decoded rows beyond it sit on masked slots and are overwritten by
         the slot's next real writes (free rollback)."""
-        import time
-
         t0 = time.perf_counter()
         eng = self._eng
         s = self.spec.seq_len
@@ -522,14 +589,22 @@ class BatchEngine:
         window = eng._window_for(max(st + max(b, 1)
                                      for st, b in zip(starts, budget)))
         loop = self._batched_loop(k, mode, window)
-        toks, rng_out, eng.k_cache, eng.v_cache = loop(
-            eng.params, eng.rope, tokens, eng.k_cache, eng.v_cache, starts,
-            rng, temps, topps, budget)
-        toks = np.asarray(toks)  # (k, B)
-        rng_out = np.asarray(rng_out)
+        with trace.span("batch.super_step", {"k": k, "rows": len(active),
+                                             "tokens": sum(budget)}):
+            toks, rng_out, eng.k_cache, eng.v_cache = loop(
+                eng.params, eng.rope, tokens, eng.k_cache, eng.v_cache, starts,
+                rng, temps, topps, budget)
+            toks = np.asarray(toks)  # (k, B)
+            rng_out = np.asarray(rng_out)
         self.decode_steps += 1
         self.super_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
+        _DISP_SUPER.observe(dt_ms / 1000.0)
+        _SUPERSTEP_TOKENS.observe(sum(budget))
+        # rows that ride the scan without a live request park for all k steps;
+        # rows with a short budget park for the steps past it
+        _PARKED_ROW_STEPS.inc(sum(k - budget[s.index] for s in active)
+                              + (self.slots_n - len(active)) * k)
         for slot in active:
             req = slot.req
             i = slot.index
@@ -538,6 +613,7 @@ class BatchEngine:
             smp = req.sampler
             state0 = int(getattr(smp, "state", 0))
             per_tok = dt_ms / b
+            req.stats.dispatch_ms.append(dt_ms)
             x = slot.last_token  # ingested input of the block's first step
             alive = True
             delivered = 0  # block tokens actually handed to the request
@@ -560,6 +636,11 @@ class BatchEngine:
                 req.error = e
                 self._finish(slot, "error")
                 alive = False
+            if delivered < b:
+                # frontier rewind: the device decoded b tokens for this row but
+                # the host delivered fewer (stop/cancel/error mid-block) — the
+                # tail sits on masked slots and is discarded
+                _ROLLBACK_TOKENS.inc(b - delivered)
             if temps[i] != 0.0 and hasattr(smp, "state"):
                 # resync the host sampler to the coins actually DELIVERED, not
                 # the full budget the device drew: a stop/cancel mid-block

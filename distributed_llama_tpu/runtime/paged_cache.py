@@ -38,7 +38,21 @@ import numpy as np
 
 from ..models.forward import forward, init_kv_cache
 from ..models.spec import ModelSpec
+from ..obs import metrics, trace
 from ..ops.rope import RopeTables
+
+_RESIDENT = metrics.gauge(
+    "paged_resident_positions", "HBM hot-ring slots (--kv-cache-resident)")
+_STORE_BYTES = metrics.gauge(
+    "paged_store_bytes", "Authoritative host/disc KV store allocation")
+_APPENDED = metrics.counter(
+    "paged_appended_rows_total", "Positions committed to the host store")
+_SPILL_BYTES = metrics.counter(
+    "paged_spill_bytes_total", "Bytes written to the disc-backed store (mmap)")
+_COLD_CALLS = metrics.counter(
+    "paged_cold_attend_calls_total", "Host cold-attention callbacks served")
+_COLD_BYTES = metrics.counter(
+    "paged_cold_bytes_total", "Cold K/V bytes read from the host store")
 
 
 class HostKVStore:
@@ -87,6 +101,8 @@ class HostKVStore:
         else:
             self.k = np.zeros(shape, dtype)
             self.v = np.zeros(shape, dtype)
+        _RESIDENT.set(resident)
+        _STORE_BYTES.set(self.nbytes())
 
     def cleanup(self) -> None:
         """Delete the cache file pair and its directory IF this store created
@@ -107,6 +123,9 @@ class HostKVStore:
         t = k_rows.shape[3]
         self.k[:, :, :, pos:pos + t] = k_rows
         self.v[:, :, :, pos:pos + t] = v_rows
+        _APPENDED.inc(t)
+        if self.storage == "disc":
+            _SPILL_BYTES.inc(k_rows.nbytes + v_rows.nbytes)
 
     def cold_attend(self, layer: int, q: np.ndarray, start_pos: int
                     ) -> tuple[np.ndarray, np.ndarray]:
@@ -121,10 +140,18 @@ class HostKVStore:
         if cold <= 0:
             return (np.zeros((b, t, hq, hs), np.float32),
                     np.full((b, t, hq), -np.inf, np.float32))
+        with trace.span("paged.cold_attend", {"layer": layer, "cold": cold}):
+            return self._cold_attend_traced(layer, q, cold)
+
+    def _cold_attend_traced(self, layer: int, q: np.ndarray, cold: int
+                            ) -> tuple[np.ndarray, np.ndarray]:
+        b, t, hq, hs = q.shape
         hk = self.k.shape[2]
         g = hq // hk
         kc = np.asarray(self.k[layer, :, :, :cold], np.float32)  # (B,hk,C,hs)
         vc = np.asarray(self.v[layer, :, :, :cold], np.float32)
+        _COLD_CALLS.inc()
+        _COLD_BYTES.inc(kc.nbytes + vc.nbytes)
         qg = q.reshape(b, t, hk, g, hs) * np.float32(1.0 / math.sqrt(hs))
         scores = np.einsum("btkgd,bkcd->btkgc", qg, kc)  # (B,T,hk,g,C)
         m = scores.max(axis=-1)

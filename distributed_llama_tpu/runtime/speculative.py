@@ -24,6 +24,19 @@ the paged hot ring's wrapped slots.
 
 from __future__ import annotations
 
+from ..obs import metrics, trace
+
+_DRAFTED = metrics.counter(
+    "spec_drafted_tokens_total", "Draft tokens proposed by prompt lookup")
+_ACCEPTED = metrics.counter(
+    "spec_accepted_tokens_total", "Draft tokens the verify step accepted")
+_VERIFY_STEPS = metrics.counter(
+    "spec_verify_steps_total", "Speculative verify dispatches")
+_ACCEPT_RATE = metrics.gauge(
+    "spec_accept_rate", "Cumulative accepted/drafted ratio (process lifetime)")
+# the shared decode-token counter (get-or-create returns engine.py's instance)
+_ENGINE_DECODE_TOKENS = metrics.counter(
+    "engine_decode_tokens_total", "Tokens decoded by the sequential engine")
 
 
 def propose_ngram(tokens: list[int], k: int, *, max_ngram: int = 4,
@@ -151,7 +164,9 @@ def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
         draft = history.propose(min(k, room - 1, max_tokens - len(out) - 1))
         block = [last] + draft
         pos_before = engine.pos
-        full = engine.infer_chunk_logits(block)  # (T, vocab)
+        with trace.span("spec.verify", {"draft": len(draft),
+                                        "pos": pos_before}):
+            full = engine.infer_chunk_logits(block)  # (T, vocab)
         stats.spec_steps += 1
         stats.spec_drafted += len(draft)
         accepted = 0
@@ -164,17 +179,24 @@ def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
             else:
                 break
         stats.spec_accepted += accepted
+        _VERIFY_STEPS.inc()
+        _DRAFTED.inc(len(draft))
+        _ACCEPTED.inc(accepted)
+        if _DRAFTED.value > 0:
+            _ACCEPT_RATE.set(_ACCEPTED.value / _DRAFTED.value)
         # real per-dispatch verify time; token_ms/infer_ms get the per-token
         # AVERAGE of it (see GenerationStats: percentiles are synthetic when
         # spec_steps > 0, aggregate tokens/s stays correct)
         dt_full = (time.perf_counter() - t0) * 1000.0
         stats.spec_step_ms.append(dt_full)
+        stats.dispatch_ms.append(dt_full)
         dt_ms = dt_full / len(emitted)
         stop_j = None
         for j, tok in enumerate(emitted):
             out.append(tok)
             history.append(tok)
             stats.generated_tokens += 1
+            _ENGINE_DECODE_TOKENS.inc()
             stats.token_ms.append(dt_ms)
             stats.infer_ms.append(dt_ms)
             if on_token is not None:

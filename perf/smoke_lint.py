@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Repo-wide syntax + dead-import smoke (wired into tier-1 via
+tests/test_smoke_lint.py).
+
+Two passes over every .py file in the repo:
+
+1. **compileall** — byte-compiles everything, so a syntax error in a
+   rarely-imported app path (the class of defect that survives a test suite
+   importing only what it tests) fails tier-1 instead of the first prod run.
+2. **dead-import lint** — pyflakes when available; otherwise a conservative
+   AST fallback: an import-bound name is flagged only when its identifier
+   appears NOWHERE else in the file text (docstrings and `__all__` strings
+   count as uses, `# noqa` on the import line opts out), so false positives
+   are structurally impossible for any name the file mentions at all.
+
+Run directly (`python perf/smoke_lint.py`) for CI/git-hook use: exit 0 clean,
+1 with findings on stderr.
+"""
+
+from __future__ import annotations
+
+import ast
+import compileall
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# directories holding first-party python (skips caches, .git, jax caches)
+_SCAN_DIRS = ("distributed_llama_tpu", "tests", "perf", "examples")
+_TOP_FILES = ("bench.py", "launch.py", "__graft_entry__.py")
+
+
+def repo_py_files() -> list[str]:
+    out = []
+    for d in _SCAN_DIRS:
+        for root, dirs, files in os.walk(os.path.join(REPO, d)):
+            dirs[:] = [x for x in dirs if not x.startswith((".", "__pycache__"))]
+            out.extend(os.path.join(root, f) for f in files if f.endswith(".py"))
+    out.extend(os.path.join(REPO, f) for f in _TOP_FILES
+               if os.path.exists(os.path.join(REPO, f)))
+    return sorted(out)
+
+
+def check_compile(files: list[str]) -> list[str]:
+    errors = []
+    for f in files:
+        # quiet=2 silences listings; failure prints to stderr AND returns False
+        if not compileall.compile_file(f, quiet=2, force=False):
+            errors.append(f"{os.path.relpath(f, REPO)}: failed to byte-compile")
+    return errors
+
+
+def _pyflakes_check(files: list[str]) -> list[str] | None:
+    """Full pyflakes run when the tool is importable; None = unavailable."""
+    try:
+        from pyflakes.api import checkPath
+        from pyflakes.reporter import Reporter
+    except ImportError:
+        return None
+    import io
+
+    out, err = io.StringIO(), io.StringIO()
+    rep = Reporter(out, err)
+    n = 0
+    for f in files:
+        n += checkPath(f, rep)
+    if n == 0:
+        return []
+    lines = [ln for ln in (out.getvalue() + err.getvalue()).splitlines() if ln]
+    # only unused-import findings gate; other pyflakes classes are advisory
+    return [ln for ln in lines if "imported but unused" in ln]
+
+
+def _fallback_dead_imports(path: str, src: str) -> list[str]:
+    """Names bound by import statements that the file never mentions again."""
+    if os.path.basename(path) == "__init__.py":
+        return []  # re-export surface: unused-looking imports are the point
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # the compile pass reports this
+    lines = src.splitlines()
+    findings = []
+    bound: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound.append(((a.asname or a.name.split(".")[0]), node.lineno))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound.append(((a.asname or a.name), node.lineno))
+    for name, lineno in bound:
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        # a name is "used" if it appears anywhere else in the file at all
+        # (code, strings, __all__, docstrings) — maximally conservative
+        uses = len(re.findall(rf"\b{re.escape(name)}\b", src))
+        if uses <= 1:
+            findings.append(f"{os.path.relpath(path, REPO)}:{lineno}: "
+                            f"'{name}' imported but unused")
+    return findings
+
+
+def check_dead_imports(files: list[str]) -> list[str]:
+    via_pyflakes = _pyflakes_check(files)
+    if via_pyflakes is not None:
+        return via_pyflakes
+    findings = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            findings.extend(_fallback_dead_imports(f, fh.read()))
+    return findings
+
+
+def main() -> int:
+    files = repo_py_files()
+    errors = check_compile(files) + check_dead_imports(files)
+    for e in errors:
+        print(e, file=sys.stderr)
+    print(f"smoke_lint: {len(files)} files, {len(errors)} finding(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from distributed_llama_tpu.models.params import init_random_params
 from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
 from distributed_llama_tpu.quants import FloatType
-from distributed_llama_tpu.runtime.device_loop import device_sample, make_decode_loop
+from distributed_llama_tpu.runtime.device_loop import device_sample
 from distributed_llama_tpu.runtime.engine import Engine
 from distributed_llama_tpu.runtime.sampler import Sampler
 

@@ -1,6 +1,5 @@
 """File-format tests: .m/.t round trips + byte compatibility with the reference writer."""
 
-import io
 import os
 
 import numpy as np
@@ -14,7 +13,7 @@ from distributed_llama_tpu.formats.mfile import (
 )
 from distributed_llama_tpu.formats.tfile import TokenizerData, load_tokenizer, write_tokenizer
 from distributed_llama_tpu.models.params import init_random_params
-from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec, RopeType
+from distributed_llama_tpu.models.spec import ArchType, HiddenAct, ModelSpec
 from distributed_llama_tpu.quants import FloatType
 
 

@@ -4,15 +4,13 @@ Replaces round 1's print-the-model-as-if-measured defect: the S/R columns now co
 from exact accounting of the compiled step program's collectives (the reference
 measured socket bytes per token, src/socket.cpp:280-285)."""
 
-import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
 
 from distributed_llama_tpu.models.params import init_random_params
 from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
-from distributed_llama_tpu.parallel.hlo_stats import (CollectiveTraffic,
-                                                      collective_traffic,
+from distributed_llama_tpu.parallel.hlo_stats import (collective_traffic,
                                                       jaxpr_collective_traffic)
 from distributed_llama_tpu.quants import FloatType
 from distributed_llama_tpu.runtime.engine import Engine

@@ -1,0 +1,219 @@
+"""Observability subsystem unit tests (obs/trace.py + obs/metrics.py):
+Prometheus exposition golden, histogram bucket boundaries, concurrent-writer
+stress, Chrome-trace schema + span nesting."""
+
+import json
+import threading
+
+from distributed_llama_tpu.obs.metrics import (
+    DEFAULT_TIME_BUCKETS, Registry, log_buckets)
+from distributed_llama_tpu.obs.trace import Tracer
+from distributed_llama_tpu.obs import trace as trace_mod
+
+
+# ----------------------------------------------------------------------
+# metrics
+# ----------------------------------------------------------------------
+
+def test_prometheus_exposition_golden():
+    """Exact text-format golden: HELP/TYPE lines, label rendering, histogram
+    bucket/sum/count suffixes, +Inf, trailing newline. Pinned so any format
+    drift is a conscious change (Prometheus parsers are strict)."""
+    reg = Registry()
+    c = reg.counter("dlt_tokens_total", "Tokens served")
+    c.inc(3)
+    g = reg.gauge("dlt_slots", "Slot state", labelnames=("state",))
+    g.labels(state="used").set(2)
+    g.labels(state="free").set(6)
+    h = reg.histogram("dlt_wait_seconds", "Queue wait", buckets=(0.01, 0.1, 1))
+    h.observe(0.05)
+    h.observe(0.05)
+    h.observe(5.0)  # overflow -> +Inf only
+    expected = (
+        "# HELP dlt_slots Slot state\n"
+        "# TYPE dlt_slots gauge\n"
+        'dlt_slots{state="free"} 6\n'
+        'dlt_slots{state="used"} 2\n'
+        "# HELP dlt_tokens_total Tokens served\n"
+        "# TYPE dlt_tokens_total counter\n"
+        "dlt_tokens_total 3\n"
+        "# HELP dlt_wait_seconds Queue wait\n"
+        "# TYPE dlt_wait_seconds histogram\n"
+        'dlt_wait_seconds_bucket{le="0.01"} 0\n'
+        'dlt_wait_seconds_bucket{le="0.1"} 2\n'
+        'dlt_wait_seconds_bucket{le="1"} 2\n'
+        'dlt_wait_seconds_bucket{le="+Inf"} 3\n'
+        "dlt_wait_seconds_sum 5.1\n"
+        "dlt_wait_seconds_count 3\n"
+    )
+    assert reg.render() == expected
+
+
+def test_histogram_bucket_boundaries():
+    """A value exactly on a bucket bound lands IN that bucket (Prometheus
+    `le` semantics: cumulative count of observations <= bound)."""
+    reg = Registry()
+    h = reg.histogram("b_seconds", "x", buckets=(1.0, 10.0))
+    h.observe(1.0)   # == first bound -> le="1" bucket
+    h.observe(1.0001)  # just past -> le="10" only
+    h.observe(10.0)  # == second bound
+    h.observe(11.0)  # overflow
+    snap = h.snapshot()
+    assert snap["buckets"] == {"1": 1, "10": 2}
+    assert snap["overflow"] == 1
+    assert snap["count"] == 4
+    text = h.render()
+    assert 'b_seconds_bucket{le="1"} 1' in text
+    assert 'b_seconds_bucket{le="10"} 3' in text  # cumulative
+    assert 'b_seconds_bucket{le="+Inf"} 4' in text
+
+
+def test_log_buckets_shape():
+    """Fixed log-scale layout: exact decade anchors, monotone, covers hi."""
+    b = log_buckets(1e-3, 10.0, per_decade=4)
+    assert b[0] == 1e-3 and b[-1] >= 10.0
+    assert all(x < y for x, y in zip(b, b[1:]))
+    for anchor in (1e-3, 1e-2, 1e-1, 1.0, 10.0):
+        assert anchor in b
+    # the default latency buckets span 100 µs .. 100 s
+    assert DEFAULT_TIME_BUCKETS[0] == 1e-4 and DEFAULT_TIME_BUCKETS[-1] == 100
+
+
+def test_labels_idempotent_and_isolated():
+    reg = Registry()
+    c = reg.counter("r_total", "x", labelnames=("route",))
+    c.labels(route="/a").inc()
+    c.labels(route="/a").inc()
+    c.labels(route="/b").inc(5)
+    assert c.labels(route="/a").value == 2
+    assert c.labels(route="/b").value == 5
+    # get-or-create returns the same family
+    assert reg.counter("r_total", "x", labelnames=("route",)) is c
+
+
+def test_concurrent_writers_metrics():
+    """8 threads hammering one counter + one histogram: no lost updates, no
+    torn histogram state (count == sum of bucket counts incl. overflow)."""
+    reg = Registry()
+    c = reg.counter("stress_total", "x")
+    h = reg.histogram("stress_seconds", "x", buckets=(0.5,))
+    N, T = 2000, 8
+
+    def work(i):
+        for j in range(N):
+            c.inc()
+            h.observe(0.25 if (i + j) % 2 else 0.75)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    snap = h.snapshot()
+    assert snap["count"] == N * T
+    assert snap["buckets"]["0.5"] + snap["overflow"] == N * T
+    assert abs(snap["sum"] - N * T * 0.5) < 1e-6
+
+
+# ----------------------------------------------------------------------
+# trace
+# ----------------------------------------------------------------------
+
+def test_chrome_trace_schema_and_nesting():
+    """Exported JSON is Chrome trace-event format: every span is a complete
+    ("X") event with µs ts/dur, and a child span's interval nests strictly
+    inside its parent's."""
+    tr = Tracer(capacity=128)
+    with tr.span("parent", {"req": 1}):
+        with tr.span("child_a"):
+            pass
+        with tr.span("child_b"):
+            pass
+    doc = json.loads(json.dumps(tr.to_chrome_trace()))  # round-trips json
+    evs = doc["traceEvents"]
+    spans = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert set(spans) == {"parent", "child_a", "child_b"}
+    for e in spans.values():
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0
+    p, a, b = spans["parent"], spans["child_a"], spans["child_b"]
+    assert p["args"] == {"req": 1}
+    # nesting: children inside the parent, in order
+    assert p["ts"] <= a["ts"] and a["ts"] + a["dur"] <= p["ts"] + p["dur"]
+    assert p["ts"] <= b["ts"] and b["ts"] + b["dur"] <= p["ts"] + p["dur"]
+    assert a["ts"] + a["dur"] <= b["ts"]
+    # thread metadata present for the emitting thread
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in evs)
+
+
+def test_trace_ring_buffer_bounded():
+    tr = Tracer(capacity=10)
+    for i in range(25):
+        with tr.span(f"s{i}"):
+            pass
+    evs = [e for e in tr.events() if e["ph"] == "X"]
+    assert len(evs) == 10
+    assert evs[0]["name"] == "s15" and evs[-1]["name"] == "s24"  # oldest dropped
+    assert tr.dropped_events == 15
+    assert tr.to_chrome_trace()["otherData"]["dropped_events"] == 15
+
+
+def test_disabled_tracer_is_noop():
+    """Module-level span() with no tracer installed returns the shared no-op
+    and records nothing once one IS installed later."""
+    trace_mod.uninstall()
+    s1 = trace_mod.span("x")
+    s2 = trace_mod.span("y", {"a": 1})
+    assert s1 is s2  # the shared singleton: no per-call allocation
+    with s1:
+        pass
+    tr = trace_mod.install(capacity=8)
+    try:
+        with trace_mod.span("real"):
+            pass
+        assert [e["name"] for e in tr.events() if e["ph"] == "X"] == ["real"]
+    finally:
+        trace_mod.uninstall()
+
+
+def test_concurrent_writer_spans():
+    """Spans from many threads interleave without loss (buffer big enough)
+    and each carries its own thread id."""
+    tr = Tracer(capacity=10000)
+    N, T = 200, 8
+
+    def work(i):
+        for j in range(N):
+            with tr.span(f"t{i}"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    evs = [e for e in tr.events() if e["ph"] == "X"]
+    assert len(evs) == N * T
+    by_thread = {}
+    for e in evs:
+        by_thread.setdefault(e["name"], set()).add(e["tid"])
+    assert len(by_thread) == T
+    for tids in by_thread.values():
+        assert len(tids) == 1  # each logical thread kept one tid
+
+    doc = tr.to_chrome_trace()
+    json.loads(json.dumps(doc))  # schema survives a full round-trip
+    # one thread_name metadata event per DISTINCT tid seen (the OS may reuse
+    # idents of already-joined threads, so distinct tids can be < T)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(meta) == len({e["tid"] for e in evs})
+
+
+def test_instant_events():
+    tr = Tracer(capacity=8)
+    tr.instant("marker", {"k": "v"})
+    evs = tr.events()
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert len(inst) == 1 and inst[0]["name"] == "marker"
+    assert inst[0]["args"] == {"k": "v"}

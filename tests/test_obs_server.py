@@ -1,0 +1,260 @@
+"""Observability integration tests: /metrics + /healthz + /v1/stats on a live
+batched api_server under concurrent requests, OpenAI-style error bodies, and
+--trace Chrome-trace emission from the CLI and the BatchEngine scheduler."""
+
+import http.client
+import json
+import re
+import threading
+
+import pytest
+
+from distributed_llama_tpu.formats.mfile import (load_model, params_file_order,
+                                                 write_model)
+from distributed_llama_tpu.formats.tfile import TokenizerData, write_tokenizer
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec
+from distributed_llama_tpu.obs import trace as trace_mod
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.apps.api_server import serve
+from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+from distributed_llama_tpu.tokenizer import TemplateType
+from distributed_llama_tpu.tokenizer.bpe import Tokenizer
+
+
+@pytest.fixture(scope="module")
+def model_files(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("obs_api")
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128, n_layers=2,
+                     n_heads=4, n_kv_heads=2, vocab_size=262, seq_len=128).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=21)
+    mpath = str(tmp / "m.m")
+    write_model(mpath, spec, params_file_order(spec, params), FloatType.F32)
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)] + \
+        [b"<|im_start|>", b"<|im_end|>", b" "]
+    scores = [0.0] * 259 + [-1.0, -1.0, -1.5]
+    tpath = str(tmp / "t.t")
+    write_tokenizer(tpath, TokenizerData(
+        vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=260,
+        max_token_length=12, chat_template="{{<|im_start|>}}"))
+    return mpath, tpath
+
+
+@pytest.fixture(scope="module")
+def obs_server(model_files):
+    """Batched server (--batch 2): the acceptance config — BatchEngine
+    scheduler metrics must show up on /metrics under concurrent requests."""
+    mpath, tpath = model_files
+    lspec, lparams = load_model(mpath, 0)
+    be = BatchEngine(lspec, lparams, Tokenizer.load(tpath), slots=2, tp=1)
+    srv = serve(None, host="127.0.0.1", port=0, template_type=TemplateType.CHATML,
+                batch_engine=be)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield port
+    srv.shutdown()
+    be.close()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", path)
+    return conn.getresponse()
+
+
+def _post(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    return conn.getresponse()
+
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})? '
+    r"(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$")
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Strict-enough exposition parse: every non-comment line must be a valid
+    sample; returns {sample_name_with_labels: float}."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    samples = {}
+    typed = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        if line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+        name_lbl, val = line.rsplit(" ", 1)
+        samples[name_lbl] = float(val.replace("+Inf", "inf"))
+        base = name_lbl.split("{")[0]
+        root = re.sub(r"_(bucket|sum|count)$", "", base)
+        assert base in typed or root in typed, f"sample {base} missing # TYPE"
+    return samples
+
+
+def test_healthz(obs_server):
+    r = _get(obs_server, "/healthz")
+    assert r.status == 200
+    assert json.loads(r.read())["status"] == "ok"
+    assert _get(obs_server, "/health").status == 200
+
+
+def test_metrics_under_concurrent_requests(obs_server):
+    """The acceptance criterion: concurrent completions against a --batch
+    server, then /metrics serves valid Prometheus text including the
+    TTFT/TPOT/E2E histograms and the BatchEngine queue/occupancy gauges."""
+    body = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 12, "temperature": 0, "seed": 5}
+    results = []
+
+    def client(i):
+        r = _post(obs_server, "/v1/chat/completions",
+                  dict(body, messages=[{"role": "user",
+                                        "content": f"hi {i}"}]))
+        results.append(r.status)
+        r.read()
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert results == [200, 200, 200]
+
+    r = _get(obs_server, "/metrics")
+    assert r.status == 200
+    assert r.getheader("Content-Type").startswith("text/plain")
+    text = r.read().decode()
+    samples = _parse_prometheus(text)
+
+    # per-request latency histograms
+    assert samples["api_request_ttft_seconds_count"] >= 3
+    assert samples["api_request_e2e_seconds_count"] >= 3
+    assert samples["api_request_tpot_seconds_count"] >= 3
+    assert samples["api_request_e2e_seconds_sum"] > 0
+    # histograms expose cumulative buckets ending in +Inf
+    assert any(k.startswith('api_request_ttft_seconds_bucket{le="')
+               for k in samples)
+    assert (samples['api_request_ttft_seconds_bucket{le="+Inf"}']
+            == samples["api_request_ttft_seconds_count"])
+
+    # BatchEngine scheduler: queue + occupancy + dispatch telemetry
+    assert samples["batch_slots_total"] == 2
+    assert "batch_slots_occupied" in samples
+    assert "batch_queue_depth" in samples
+    assert samples["batch_queue_wait_seconds_count"] >= 3
+    assert samples["batch_prefill_tokens_total"] > 0
+    assert samples["batch_decode_tokens_total"] > 0
+    dispatch = [k for k in samples
+                if k.startswith('batch_dispatch_seconds_bucket')]
+    assert dispatch, "per-dispatch histogram missing"
+    # HTTP accounting saw the completions and this scrape's own route
+    assert samples[
+        'api_http_requests_total{route="/v1/chat/completions",code="200"}'] >= 3
+
+
+def test_v1_stats_snapshot(obs_server):
+    # self-contained: issue one completion so the snapshot has traffic even
+    # when this test runs first / in isolation
+    r = _post(obs_server, "/v1/chat/completions",
+              {"messages": [{"role": "user", "content": "stats"}],
+               "max_tokens": 4, "temperature": 0})
+    assert r.status == 200
+    r.read()
+    r = _get(obs_server, "/v1/stats")
+    assert r.status == 200
+    data = json.loads(r.read())
+    assert data["model"] == "distributed-llama-tpu"
+    be = data["batch_engine"]
+    assert be["slots"] == 2 and be["superstep"] >= 1
+    assert be["prefilled_tokens"] > 0
+    # the same histogram data as /metrics, JSON-shaped
+    ttft = data["metrics"]["api_request_ttft_seconds"]
+    assert ttft["count"] >= 1 and "buckets" in ttft
+
+
+def test_openai_error_bodies(obs_server):
+    # unknown route: GET and POST
+    for r in (_get(obs_server, "/v1/embeddings"),
+              _post(obs_server, "/v1/embeddings", {"input": "x"})):
+        assert r.status == 404
+        err = json.loads(r.read())["error"]
+        assert err["type"] == "invalid_request_error" and err["message"]
+    # malformed JSON body
+    conn = http.client.HTTPConnection("127.0.0.1", obs_server, timeout=30)
+    conn.request("POST", "/v1/chat/completions", "{not json",
+                 {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 400
+    err = json.loads(r.read())["error"]
+    assert err["type"] == "invalid_request_error"
+    # missing messages[]
+    r = _post(obs_server, "/v1/chat/completions", {"max_tokens": 4})
+    assert r.status == 400
+    assert json.loads(r.read())["error"]["type"] == "invalid_request_error"
+
+
+def test_dllama_trace_flag(model_files, tmp_path, capsys):
+    """`dllama --trace out.json` writes a Chrome trace that round-trips
+    json.load with engine.dispatch spans nested inside engine.prefill."""
+    from distributed_llama_tpu.apps import dllama
+
+    mpath, tpath = model_files
+    out = str(tmp_path / "trace.json")
+    try:
+        dllama.main(["inference", "--model", mpath, "--tokenizer", tpath,
+                     "--tp", "1", "--steps", "4", "--prompt", "ab ab ab ab ab",
+                     "--temperature", "0", "--trace", out])
+    finally:
+        trace_mod.uninstall()
+    with open(out) as f:
+        doc = json.load(f)
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    prefills = [e for e in evs if e["name"] == "engine.prefill"]
+    dispatches = [e for e in evs if e["name"] == "engine.dispatch"]
+    assert prefills and dispatches
+    p = prefills[0]
+    nested = [d for d in dispatches
+              if p["ts"] <= d["ts"] and
+              d["ts"] + d["dur"] <= p["ts"] + p["dur"]]
+    assert nested, "prefill chunk dispatches must nest inside engine.prefill"
+    # decode dispatches follow the prefill span
+    assert any(d["ts"] >= p["ts"] + p["dur"] for d in dispatches)
+
+
+def test_batch_trace_superstep_spans(model_files):
+    """Tracing a BatchEngine run records super-step spans that do not overlap
+    on the scheduler thread (the nesting/ordering the acceptance names)."""
+    mpath, tpath = model_files
+    lspec, lparams = load_model(mpath, 0)
+    be = BatchEngine(lspec, lparams, Tokenizer.load(tpath), slots=2, tp=1,
+                     superstep=4)
+    tr = trace_mod.install(capacity=4096)
+    try:
+        from distributed_llama_tpu.runtime.sampler import Sampler
+
+        sampler = Sampler(lspec.vocab_size, 0.0, 0.9, 0)
+        out, _ = be.generate([1, 5, 9, 13], 12, sampler)
+        assert len(out) == 12
+        evs = [e for e in tr.events() if e["ph"] == "X"]
+        supers = sorted((e for e in evs if e["name"] == "batch.super_step"),
+                        key=lambda e: e["ts"])
+        prefills = [e for e in evs if e["name"] in ("batch.prefill",
+                                                    "batch.mixed_step")]
+        assert supers and prefills
+        assert supers[0]["args"]["k"] == 4
+        # scheduler spans are sequential: no super-step starts before the
+        # previous one (same thread) ended
+        for a, b in zip(supers, supers[1:]):
+            assert a["ts"] + a["dur"] <= b["ts"] + 1e-3
+        # prefill precedes the first super-step
+        assert min(e["ts"] for e in prefills) <= supers[0]["ts"]
+    finally:
+        trace_mod.uninstall()
+        be.close()

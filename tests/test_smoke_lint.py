@@ -1,0 +1,32 @@
+"""Tier-1 wiring for perf/smoke_lint.py: every .py in the repo must
+byte-compile and carry no dead imports — a syntax error or stale import in a
+rarely-exercised app path fails HERE instead of in production (ISSUE 2
+satellite; pyflakes when installed, conservative AST fallback otherwise)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "perf"))
+
+import smoke_lint  # noqa: E402
+
+
+def test_repo_compiles_and_no_dead_imports():
+    files = smoke_lint.repo_py_files()
+    assert len(files) > 50, "scan did not find the repo"
+    errors = smoke_lint.check_compile(files)
+    assert not errors, "\n".join(errors)
+    dead = smoke_lint.check_dead_imports(files)
+    assert not dead, "\n".join(dead)
+
+
+def test_fallback_checker_flags_planted_dead_import(tmp_path):
+    """The AST fallback actually detects the defect class it exists for,
+    and respects the noqa escape hatch."""
+    bad = tmp_path / "mod.py"
+    bad.write_text("import os\nimport json\nprint(json.dumps({}))\n")
+    findings = smoke_lint._fallback_dead_imports(str(bad), bad.read_text())
+    assert len(findings) == 1 and "'os' imported but unused" in findings[0]
+    ok = tmp_path / "ok.py"
+    ok.write_text("import os  # noqa: side-effect import\n")
+    assert smoke_lint._fallback_dead_imports(str(ok), ok.read_text()) == []
