@@ -19,6 +19,14 @@ costs. --layout i8 benches the older int8-plane kernel for comparison.
 Usage: python bench.py [--small] [--steps N] [--tp N] [--layout i4p|i8]
                        [--device-loop N] [--window W]
                        [--batch B --superstep K]   (serving throughput mode)
+                       [--workload shared-prefix]  (prefix-cache TTFT mode)
+
+--workload shared-prefix drives the BatchEngine scheduler with a synthetic
+multi-request workload (one common system prompt + distinct user turns) twice
+— prefix cache ON vs OFF — and reports per-request TTFT p50/p95 for both plus
+the cache's measured `prefix_hit_rate` (docs/PREFIX_CACHE.md). This is a
+scheduler/cache workload bench (random Q40 weights via init_random_params),
+not a kernel-layout bench.
 
 --batch B runs the BatchEngine's hot path — the batched K-step device loop
 (runtime/device_loop.py make_batched_decode_loop) over B cache rows — and
@@ -282,6 +290,93 @@ def synth_params(spec: ModelSpec, layout: str, fuse: bool = True, tp: int = 1):
 
 
 
+def shared_prefix_workload(args, spec):
+    """--workload shared-prefix: TTFT with the prefix cache on vs off.
+
+    One warm request establishes the shared prefix, then `--requests - 1`
+    followers (same system prompt, distinct user turns) are submitted
+    concurrently; TTFT is submit() -> first on_token. The identical schedule
+    runs against a cache-on and a cache-off BatchEngine; compiled shapes are
+    warmed by the leading request in both, so the delta isolates what the
+    cache buys: the followers' shared-prefix prefill."""
+    from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.quants import FloatType as _FTy
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    n_req = max(args.requests, 2)
+    gen = 4  # decoded tokens per request: enough to stream, TTFT-dominated
+    shared_len = args.shared_prefix
+    if shared_len + 8 + gen >= spec.seq_len:
+        shared_len = spec.seq_len - 8 - gen
+    assert shared_len >= 16, f"seq_len {spec.seq_len} too small for the workload"
+    rng = np.random.default_rng(0)
+    shared = [1] + [int(t) for t in
+                    rng.integers(2, spec.vocab_size, shared_len - 1)]
+    prompts = [shared + [2 + i, 3 + i, 4 + i] for i in range(n_req)]
+    params = init_random_params(spec, _FTy.Q40, seed=0)
+    # default: every follower gets a slot immediately, so TTFT isolates the
+    # prefill the cache removes instead of queue wait behind busy slots
+    B = args.batch if args.batch > 0 else min(max(n_req - 1, 2), 8)
+    out = {}
+    for label, on in (("on", True), ("off", False)):
+        be = BatchEngine(spec, params, slots=B,
+                         superstep=max(args.superstep, 1), tp=args.tp,
+                         prefix_cache=on)
+        try:
+            be.generate(list(prompts[0]), gen,
+                        Sampler(spec.vocab_size, temperature=0.0))
+            ttfts = {}
+            t0s = {}
+
+            def on_tok(i):
+                def cb(_t, i=i):
+                    if i not in ttfts:
+                        ttfts[i] = time.perf_counter() - t0s[i]
+                return cb
+
+            reqs = []
+            for i in range(1, n_req):
+                t0s[i] = time.perf_counter()
+                reqs.append(be.submit(list(prompts[i]), gen,
+                                      Sampler(spec.vocab_size, temperature=0.0),
+                                      on_token=on_tok(i)))
+            t_all0 = time.perf_counter()
+            for r in reqs:
+                r.wait(timeout=600)
+            e2e = time.perf_counter() - t_all0
+            lat = sorted(ttfts.values())
+            out[label] = {
+                "ttft_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+                "ttft_p95_ms": round(
+                    lat[min(int(len(lat) * 0.95), len(lat) - 1)] * 1e3, 2),
+                "e2e_s": round(e2e, 3),
+            }
+            if on:
+                st = be.prefix_cache.stats()
+                out["prefix_hit_rate"] = round(st["hit_rate"], 3)
+                out["lookup_hit_rate"] = round(st["lookup_hit_rate"], 3)
+                out["hit_tokens"] = st["hit_tokens"]
+                out["pool_blocks"] = st["pool_blocks"]
+        finally:
+            be.close()
+    print(json.dumps({
+        "metric": "shared_prefix_ttft_p50_ms",
+        "value": out["on"]["ttft_p50_ms"], "unit": "ms", "vs_baseline": None,
+        "ttft_p95_ms": out["on"]["ttft_p95_ms"],
+        "ttft_off_p50_ms": out["off"]["ttft_p50_ms"],
+        "ttft_off_p95_ms": out["off"]["ttft_p95_ms"],
+        "ttft_speedup_p50": round(
+            out["off"]["ttft_p50_ms"] / max(out["on"]["ttft_p50_ms"], 1e-9), 3),
+        "e2e_s_on": out["on"]["e2e_s"], "e2e_s_off": out["off"]["e2e_s"],
+        "prefix_hit_rate": out["prefix_hit_rate"],
+        "lookup_hit_rate": out["lookup_hit_rate"],
+        "hit_tokens": out["hit_tokens"], "pool_blocks": out["pool_blocks"],
+        "requests": n_req, "shared_prefix": shared_len, "batch": B,
+        "superstep": max(args.superstep, 1),
+    }))
+
+
 def vs_baseline(args, tok_s: float):
     """Ratio vs the reference's published number — which exists only for the
     Llama-2-7B single-node config (README.md:131). Other archs report null rather
@@ -390,6 +485,16 @@ def main():
     ap.add_argument("--prefill", type=int, default=0, metavar="T",
                     help="bench chunked prefill throughput at chunk size T instead "
                          "of decode")
+    ap.add_argument("--workload", choices=("shared-prefix",), default=None,
+                    help="scenario mode: 'shared-prefix' drives the BatchEngine "
+                         "with a common-system-prompt multi-request workload and "
+                         "reports TTFT p50/p95 + prefix_hit_rate, cache on vs off")
+    ap.add_argument("--requests", type=int, default=5, metavar="N",
+                    help="shared-prefix workload: total requests (1 warm + N-1 "
+                         "concurrent followers)")
+    ap.add_argument("--shared-prefix", type=int, default=192, metavar="T",
+                    help="shared-prefix workload: tokens in the common system "
+                         "prompt (clamped to fit seq_len)")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed region here")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
@@ -434,12 +539,18 @@ def main():
         getattr(args, k) == ap.get_default(k)
         for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
                   "window", "cache_write", "no_fuse", "prologue",
-                  "prefill_kernel", "kv_paged", "batch", "superstep", "trace")
+                  "prefill_kernel", "kv_paged", "batch", "superstep", "trace",
+                  "workload")
     ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
     if args.batch > 0 and (args.prefill > 0 or args.device_loop > 0
                            or args.kv_paged > 0):
         ap.error("--batch is its own mode (batched K-step decode); combine "
                  "only with --superstep/--steps/--arch/--layout/--tp")
+    if args.workload and (args.prefill > 0 or args.device_loop > 0
+                          or args.kv_paged > 0):
+        ap.error("--workload shared-prefix is its own mode; combine only with "
+                 "--small/--arch/--batch/--superstep/--requests/"
+                 "--shared-prefix/--tp")
     if args.kv_paged > 0 and args.tp > 1:
         # before any mesh/device work so the error beats a mesh-size crash
         ap.error("--kv-paged is single-chip (the paged step is an unsharded "
@@ -555,6 +666,9 @@ def main():
 
     on_tpu = backend == "tpu"
     spec = ModelSpec(**(SMALL if args.small else ARCHS[args.arch])).resolved()
+    if args.workload == "shared-prefix":
+        shared_prefix_workload(args, spec)
+        return
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     layout = args.layout if on_tpu else "planar"
     window = min(max(args.window, 64), spec.seq_len)

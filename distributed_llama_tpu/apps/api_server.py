@@ -2,10 +2,13 @@
 
 TPU-native counterpart of src/apps/dllama-api/dllama-api.cpp: `POST /v1/chat/completions`
 (streaming SSE via chunked transfer + non-streaming JSON), `GET /v1/models`, per-request
-temperature/seed/max_tokens/stop overrides (dllama-api.cpp:351-380), and the NaiveCache
-longest-prefix KV reuse (dllama-api.cpp:187-232) — reformulated over token ids: the engine
-keeps the previous conversation's KV; a new request reuses the longest common token
-prefix and rewinds `pos` instead of re-prefilling.
+temperature/seed/max_tokens/stop overrides (dllama-api.cpp:351-380), and prefix KV reuse
+through the shared-prefix cache subsystem (cache/, docs/PREFIX_CACHE.md), which subsumes
+the reference's NaiveCache (dllama-api.cpp:187-232): the engine keeps the previous
+conversation's KV and rewinds `pos` over the longest common token prefix, AND prefixes
+harvested from past conversations are radix-indexed in a block pool, so returning to a
+displaced conversation (or sharing its system prompt) seeds the cache instead of
+re-prefilling.
 
 With `--batch 1` (default) requests serialize behind a generation lock — the reference
 is likewise a single-request-at-a-time accept loop (dllama-api.cpp:418-429). With
@@ -53,34 +56,31 @@ def _count_http(path: str, code: int) -> None:
     _HTTP.labels(route=route, code=str(code)).inc()
 
 
-class NaiveCache:
-    """Longest-common-token-prefix KV reuse (NaiveCache, dllama-api.cpp:187-232)."""
-
-    def __init__(self):
-        self.tokens: list[int] = []
-
-    def resolve(self, prompt: list[int]) -> int:
-        """Return number of leading prompt tokens already in the KV cache."""
-        n = 0
-        for a, b in zip(self.tokens, prompt):
-            if a != b:
-                break
-            n += 1
-        # never reuse the full prompt — the last token must be re-inferred for logits
-        return min(n, max(len(prompt) - 1, 0))
-
-    def update(self, tokens: list[int]) -> None:
-        self.tokens = list(tokens)
-
-
 class ApiState:
     def __init__(self, engine: Engine, template_type: TemplateType,
                  default_sampler: Sampler, device_loop_chunk: int = 0,
-                 batch_engine=None, speculative_k: int = 0):
+                 batch_engine=None, speculative_k: int = 0,
+                 prefix_cache=True, prefix_cache_blocks: int = 0,
+                 prefix_block_tokens: int = 16, prefix_cache_q80: bool = False):
         self.engine = engine
         self.batch_engine = batch_engine  # BatchEngine when --batch > 1, else None
         self.lock = threading.Lock()
-        self.cache = NaiveCache()
+        # single-slot prefix reuse (cache/single_slot.py, ex-NaiveCache): the
+        # resident-conversation rewind plus the cross-conversation radix pool.
+        # Batched mode needs neither — slot assignment and prefix reuse live
+        # in the BatchEngine scheduler (which owns its own PrefixCache).
+        self.cache = None
+        if engine is not None:
+            from ..cache import SingleSlotCache, make_prefix_cache
+
+            pc = None
+            if not engine.paged:
+                pc = make_prefix_cache(
+                    engine.k_cache.shape, engine.k_cache.dtype.itemsize,
+                    slots=1, prefix_cache=prefix_cache,
+                    blocks=prefix_cache_blocks,
+                    block_tokens=prefix_block_tokens, q80=prefix_cache_q80)
+            self.cache = SingleSlotCache(engine, pc)
         tok = (batch_engine or engine).tokenizer
         self.template = ChatTemplate(template_type, tok.chat_template, tok.eos_piece())
         self.default_sampler = default_sampler
@@ -126,6 +126,10 @@ def _stats_payload(state: "ApiState") -> dict:
     out: dict = {"model": state.model_name, "time": _now(),
                  "metrics": metrics.snapshot()}
     be = state.batch_engine
+    pc = (be.prefix_cache if be is not None
+          else state.cache.cache if state.cache is not None else None)
+    if pc is not None:
+        out["prefix_cache"] = pc.stats()
     if be is not None:
         out["batch_engine"] = {
             "slots": be.slots_n, "superstep": be.superstep,
@@ -240,11 +244,11 @@ def run_completion(state: ApiState, body: dict, emit):
         emit(text)
 
     streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
-    # NaiveCache prefix reuse: rewind pos to the common token prefix (seek
-    # also restores the paged hot ring from the host store — a bare pos
-    # assignment would leave wrapped slots holding the abandoned branch's rows)
-    reuse = state.cache.resolve(prompt)
-    engine.seek(reuse)
+    # Prefix reuse (cache/single_slot.py): rewind pos over the resident
+    # conversation's common prefix (for paged engines, begin() also restores
+    # the hot ring from the host store via Engine.seek) and/or seed cache rows
+    # from the cross-conversation block pool — prefill covers only the rest.
+    reuse = state.cache.begin(prompt)
     delta_prompt = prompt[reuse:]
 
     try:
@@ -261,13 +265,13 @@ def run_completion(state: ApiState, body: dict, emit):
                                            history_tokens=prompt)
     except Exception:
         # KV may hold a half-written new conversation; drop the reuse index entirely
-        state.cache.update([])
+        state.cache.invalidate()
         raise
     if streamer.stopped:
         finish[0] = "stop"
     # only tokens whose KV was actually written are reusable (a final stop token is
     # sampled but never inferred, so engine.pos may be one short of prompt+out)
-    state.cache.update((prompt + out)[: engine.pos])
+    state.cache.end((prompt + out)[: engine.pos])
     _observe_done(t_start, ttft, len(out))
     return "".join(pieces), finish[0]
 
@@ -379,7 +383,9 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           template_type: TemplateType = TemplateType.UNKNOWN,
           default_sampler: Sampler | None = None,
           device_loop_chunk: int = 0, batch_engine=None,
-          speculative_k: int = 0) -> ThreadingHTTPServer:
+          speculative_k: int = 0, prefix_cache=True,
+          prefix_cache_blocks: int = 0, prefix_block_tokens: int = 16,
+          prefix_cache_q80: bool = False) -> ThreadingHTTPServer:
     if batch_engine is not None and speculative_k > 0:
         # guard EVERY caller, not just the CLI: the batch scheduler has no
         # per-request verify dispatch, so the flag would be silently inert
@@ -389,7 +395,10 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
     state = ApiState(engine, template_type,
                      default_sampler or Sampler(runner.spec.vocab_size, 0.7, 0.9, 0),
                      device_loop_chunk, batch_engine=batch_engine,
-                     speculative_k=speculative_k)
+                     speculative_k=speculative_k, prefix_cache=prefix_cache,
+                     prefix_cache_blocks=prefix_cache_blocks,
+                     prefix_block_tokens=prefix_block_tokens,
+                     prefix_cache_q80=prefix_cache_q80)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = ThreadingHTTPServer((host, port), handler)
     print(f"🟢 dllama-api listening on {host}:{port}")
@@ -418,6 +427,21 @@ def main(argv=None) -> None:
     p.add_argument("--dp", type=int, default=1,
                    help="data-parallel mesh axis: shard the --batch cache rows over "
                         "N device groups (requires --batch divisible by N)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the cross-request shared-prefix KV cache "
+                        "(docs/PREFIX_CACHE.md); prefix reuse falls back to "
+                        "the reference-style resident/slot rewind only")
+    p.add_argument("--prefix-cache-blocks", type=int, default=0, metavar="N",
+                   help="prefix-cache pool capacity in blocks (0 = auto: 4 "
+                        "contexts per slot set, capped at ~1 GiB host RAM)")
+    p.add_argument("--prefix-cache-block-tokens", type=int, default=16,
+                   metavar="T", help="tokens per prefix-cache block (reuse "
+                        "granularity; smaller = finer matches, more nodes)")
+    p.add_argument("--prefix-cache-q80", action="store_true",
+                   help="Q80-compress cold prefix-cache blocks (~3.8x denser "
+                        "than f32) — capacity over bit-exactness: a cold hit "
+                        "is a near-lossless dequantized seed, not an exact "
+                        "replay (docs/PREFIX_CACHE.md cost model)")
     args = p.parse_args(argv)
     from .dllama import dump_trace, install_trace
 
@@ -450,6 +474,10 @@ def main(argv=None) -> None:
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
             slots=args.batch, superstep=max(args.superstep, 1),
+            prefix_cache=not args.no_prefix_cache,
+            prefix_cache_blocks=args.prefix_cache_blocks,
+            prefix_block_tokens=args.prefix_cache_block_tokens,
+            prefix_cache_q80=args.prefix_cache_q80,
             tp=args.tp, dp=args.dp, pod=args.pod,
             cache_write=args.cache_write, moe_sharding=args.moe_sharding,
             fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
@@ -470,7 +498,11 @@ def main(argv=None) -> None:
     server = serve(engine, args.host, args.port,
                    TemplateType(args.chat_template) if args.chat_template
                    else TemplateType.UNKNOWN, sampler, args.device_loop,
-                   batch_engine=batch_engine, speculative_k=args.speculative)
+                   batch_engine=batch_engine, speculative_k=args.speculative,
+                   prefix_cache=not args.no_prefix_cache,
+                   prefix_cache_blocks=args.prefix_cache_blocks,
+                   prefix_block_tokens=args.prefix_cache_block_tokens,
+                   prefix_cache_q80=args.prefix_cache_q80)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,62 @@
+"""Shared-prefix KV cache subsystem (docs/PREFIX_CACHE.md).
+
+The cross-request layer between request admission and the device KV cache:
+
+- `radix.py`    — token-block radix index (refcounts, LRU, hit accounting)
+- `block_pool.py` — bounded host block store (hot tier + optional Q80 tier)
+- `prefix_cache.py` — the facade: lookup/insert/leases/eviction + metrics
+- `single_slot.py`  — Engine (api_server --batch 1) client, retiring NaiveCache
+
+BatchEngine integrates directly (runtime/batch_engine.py: admission seeding in
+`_assign`, harvest in `_finish`).
+"""
+
+from .block_pool import KVBlockPool
+from .prefix_cache import PrefixCache, PrefixLease
+from .radix import RadixIndex
+from .single_slot import SingleSlotCache
+
+__all__ = ["KVBlockPool", "PrefixCache", "PrefixLease", "RadixIndex",
+           "SingleSlotCache", "default_pool_blocks", "make_prefix_cache",
+           "warn_degraded"]
+
+
+def make_prefix_cache(cache_shape, itemsize: int, *, slots: int,
+                      prefix_cache=True, blocks: int = 0,
+                      block_tokens: int = 16,
+                      q80: bool = False) -> PrefixCache | None:
+    """The one PrefixCache construction path for every engine entry point
+    (BatchEngine and the single-slot ApiState): resolves the enable flag /
+    passthrough-instance convention and the auto pool sizing, so the two
+    surfaces cannot drift."""
+    if not prefix_cache:
+        return None
+    if isinstance(prefix_cache, PrefixCache):
+        return prefix_cache
+    n = blocks or default_pool_blocks(cache_shape, itemsize, block_tokens,
+                                      slots)
+    return PrefixCache(max_blocks=n, block_tokens=block_tokens, q80=q80)
+
+
+def warn_degraded(what: str, exc: Exception) -> None:
+    """Uniform stderr warning for cache degradations (seed/insert failures):
+    the cache is an optimization, never a correctness gate — callers fall
+    back to plain prefill/no-harvest after calling this."""
+    import sys
+
+    print(f"⚠️  prefix-cache {what} failed ({type(exc).__name__}: {exc}); "
+          "continuing without it", file=sys.stderr)
+
+
+def default_pool_blocks(cache_shape, itemsize: int, block_tokens: int,
+                        slots: int, byte_budget: int = 1 << 30) -> int:
+    """Default pool capacity: 4 full contexts per slot set, hard-capped by a
+    host byte budget (~1 GiB). The budget wins even when it holds less than
+    one full context — a partial-prefix cache (system prompts are usually
+    far shorter than seq_len) is still useful, a silent multi-GiB host
+    allocation is not. Size explicitly via prefix_cache_blocks for more."""
+    n_layers, _b, hk, seq_len, hs = cache_shape
+    blocks_per_seq = -(-seq_len // block_tokens)
+    block_bytes = 2 * n_layers * hk * block_tokens * hs * itemsize
+    cap = max(byte_budget // block_bytes, 1)
+    return int(min(4 * max(slots, 1) * blocks_per_seq, cap))

@@ -1,0 +1,149 @@
+"""Bounded host-side KV block store: F32/BF16 hot tier + optional Q80 cold tier.
+
+Each block holds the committed (K, V) rows of `block_tokens` consecutive
+positions for every layer — shape (L, hk, block_tokens, hs) per side, exactly
+the slice a slot's contiguous (B, hk, S, hs) device cache rows scatter from /
+gather into (runtime/batch_engine.py admission seed and finish harvest).
+
+Tiering applies the Opt4GPTQ co-optimization idea (PAPERS.md) to cache
+capacity: hot blocks keep the engine dtype bit-exactly (a hot hit reproduces
+the original prefill's rows and therefore the original tokens exactly); when
+the hot tier overflows its budget, the LRU hot blocks are demoted to Q80
+(quants.quantize_q80 over the flattened rows — 34 bytes per 32 values,
+~3.8x denser than f32) and a cold hit pays one dequantize. Blocks whose
+element count is not a multiple of the Q80 block size stay hot (never true
+for even head sizes).
+
+The pool never evicts on its own: cache/prefix_cache.py drives eviction
+through the radix index (which knows refcounts and LRU order) and calls
+`free` with the handles the tree surrenders. No internal lock for the same
+reason — the facade's single lock covers tree + pool together.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from ..quants import QK, dequantize_q80, quantize_q80
+
+__all__ = ["KVBlockPool"]
+
+
+class _Block:
+    __slots__ = ("k", "v", "kq", "vq", "shape", "dtype", "seq")
+
+    def __init__(self, k: np.ndarray, v: np.ndarray, seq: int):
+        self.k = k            # hot: ndarray (L, hk, N, hs); None when cold
+        self.v = v
+        self.kq = None        # cold: (values int8, scales f16) of the flat rows
+        self.vq = None
+        self.shape = k.shape
+        self.dtype = k.dtype
+        self.seq = seq        # hot-LRU clock value of the last touch
+
+    @property
+    def cold(self) -> bool:
+        return self.k is None
+
+    def nbytes(self) -> int:
+        if self.cold:
+            return sum(q[0].nbytes + q[1].nbytes for q in (self.kq, self.vq))
+        return self.k.nbytes + self.v.nbytes
+
+
+class KVBlockPool:
+    def __init__(self, max_blocks: int, hot_blocks: int | None = None,
+                 q80: bool = False):
+        assert max_blocks >= 1
+        self.max_blocks = max_blocks
+        # q80 off => everything stays hot (the bit-exact default; the
+        # acceptance bar is token-identical output with the cache enabled)
+        self.hot_blocks = (max_blocks if not q80
+                           else max(1, hot_blocks if hot_blocks is not None
+                                    else max_blocks // 4))
+        self.q80 = q80
+        self._blocks: dict[int, _Block] = {}
+        self._next_handle = 0
+        # LRU clock. itertools.count: get() runs OUTSIDE the facade lock
+        # (prefix_cache.fetch) concurrently with locked put/demote — a plain
+        # `+= 1` there would lose increments and hand two blocks the same
+        # stamp, steering the q80 demotion at the wrong "LRU" block
+        self._seq = itertools.count(1)
+        self.demoted_blocks = 0  # lifetime hot->Q80 demotions (stats)
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+    @property
+    def full(self) -> bool:
+        return len(self._blocks) >= self.max_blocks
+
+    def hot_count(self) -> int:
+        return sum(1 for b in self._blocks.values() if not b.cold)
+
+    def nbytes(self) -> int:
+        return sum(b.nbytes() for b in self._blocks.values())
+
+    # ------------------------------------------------------------------
+
+    def put(self, k: np.ndarray, v: np.ndarray) -> int | None:
+        """Commit one block (copies taken); returns a handle, or None when the
+        pool is at capacity (caller evicts via the radix index and retries)."""
+        if self.full:
+            return None
+        assert k.shape == v.shape
+        h = self._next_handle
+        self._next_handle += 1
+        self._blocks[h] = _Block(np.array(k, copy=True), np.array(v, copy=True),
+                                 next(self._seq))
+        self._maybe_demote()
+        return h
+
+    def get(self, handle: int) -> tuple[np.ndarray, np.ndarray]:
+        """Block data in its original dtype/shape; a cold block dequantizes
+        (Q80 round-trip precision, not bit-exact — see module docstring).
+
+        Callers may read outside the facade lock (prefix_cache.lookup), so a
+        concurrent demotion can clear b.k between a tier check and the read —
+        snapshot the hot arrays once and fall through to the cold path when
+        they vanished (demotion assigns kq/vq BEFORE clearing k/v)."""
+        b = self._blocks[handle]
+        b.seq = next(self._seq)
+        k, v = b.k, b.v
+        if k is not None and v is not None:  # demotion may land between reads
+            return k, v
+        k = dequantize_q80(*b.kq).reshape(b.shape).astype(b.dtype)
+        v = dequantize_q80(*b.vq).reshape(b.shape).astype(b.dtype)
+        return k, v
+
+    def is_cold(self, handle: int) -> bool:
+        return self._blocks[handle].cold
+
+    def free(self, handle: int) -> None:
+        del self._blocks[handle]
+
+    # ------------------------------------------------------------------
+
+    def _maybe_demote(self) -> None:
+        if not self.q80:
+            return
+        import heapq
+
+        hot = [b for b in self._blocks.values() if not b.cold]
+        excess = len(hot) - self.hot_blocks
+        if excess <= 0:
+            return
+        # nsmallest over the (normally 1-deep) excess: O(H), not a full sort
+        # per put — a harvest inserts block-by-block and each put can push the
+        # tier over budget by at most one
+        compressible = (b for b in hot if int(np.prod(b.shape)) % QK == 0)
+        for b in heapq.nsmallest(excess, compressible, key=lambda b: b.seq):
+            n = int(np.prod(b.shape))
+            # f32 intermediary: quantize_q80 upcasts anyway, and bf16 ndarrays
+            # (ml_dtypes) don't support every ufunc the quantizer uses
+            b.kq = quantize_q80(np.asarray(b.k, np.float32).reshape(n))
+            b.vq = quantize_q80(np.asarray(b.v, np.float32).reshape(n))
+            b.k = b.v = None
+            self.demoted_blocks += 1

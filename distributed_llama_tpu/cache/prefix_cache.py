@@ -1,0 +1,295 @@
+"""Shared-prefix KV cache facade: radix index + block pool + leases + metrics.
+
+The cross-request layer between admission and the device cache: two concurrent
+users sharing a 2k-token system prompt should pay its prefill ONCE. A finished
+request's committed prefix is harvested into the block pool (copy-out), and a
+new request whose prompt shares a cached block-prefix seeds its slot rows from
+the pool (copy-in) so prefill runs only on the uncached suffix — repeated
+prefill becomes a KV copy, directly attacking TTFT.
+
+Leases: a lookup that hits acquires the matched nodes' refcounts and returns a
+`PrefixLease` the caller holds for the request's lifetime (eviction respects
+in-flight slots — a popular system prompt cannot be churned out from under the
+requests using it). The slot's seeded data is a COPY, so a lease is an
+anti-churn pin, not a data dependency; `shrink` releases the tail of a lease
+when the scheduler truncates a slot's reusable history (clamped parks,
+runtime/batch_engine.py _park_positions).
+
+Locking: one lock covers the tree and the pool together — lookups come from
+the BatchEngine scheduler thread and (in single-slot mode) HTTP handler
+threads concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..obs import metrics
+from .block_pool import KVBlockPool
+from .radix import RadixIndex, RadixNode
+
+__all__ = ["PrefixCache", "PrefixLease"]
+
+# Cross-request prefix cache telemetry (docs/PREFIX_CACHE.md). Counters are
+# process-global (all engines in the process share the family); per-instance
+# copies live on PrefixCache for bench/stats isolation.
+_HITS = metrics.counter(
+    "prefix_cache_hits_total",
+    "Lookups whose cached blocks were actually applied to a slot")
+_MISSES = metrics.counter(
+    "prefix_cache_misses_total", "Prompt lookups with no cached block")
+_UNUSED = metrics.counter(
+    "prefix_cache_unused_hits_total",
+    "Lookups that matched blocks the slot rewind already covered (discarded)")
+_HIT_TOKENS = metrics.counter(
+    "prefix_cache_hit_tokens_total",
+    "Prompt tokens served from cached KV blocks instead of prefill")
+_EVICTED = metrics.counter(
+    "prefix_cache_evicted_blocks_total", "Blocks LRU-evicted from the pool")
+_INSERTED = metrics.counter(
+    "prefix_cache_inserted_blocks_total", "Blocks committed to the pool")
+_POOL_BLOCKS = metrics.gauge(
+    "prefix_cache_pool_blocks", "Blocks resident in the pool (hot + cold)")
+_POOL_HOT = metrics.gauge(
+    "prefix_cache_pool_hot_blocks", "Blocks resident in the uncompressed tier")
+_POOL_BYTES = metrics.gauge(
+    "prefix_cache_pool_bytes", "Host bytes held by the block pool")
+_TREE_NODES = metrics.gauge(
+    "prefix_cache_tree_nodes", "Nodes in the radix index")
+
+
+@dataclass
+class PrefixLease:
+    """Refcount pin on the radix chain a request was seeded from. `tokens` is
+    the seeded token count (may end mid-block: block data is copied into the
+    slot, so partial use of the last block is free)."""
+
+    nodes: list[RadixNode] = field(default_factory=list)
+    tokens: int = 0
+
+
+class PrefixCache:
+    def __init__(self, max_blocks: int, block_tokens: int = 16,
+                 hot_blocks: int | None = None, q80: bool = False):
+        self.block_tokens = block_tokens
+        self.radix = RadixIndex(block_tokens)
+        self.pool = KVBlockPool(max_blocks, hot_blocks=hot_blocks, q80=q80)
+        self._lock = threading.Lock()
+        # per-instance accounting (the module counters aggregate all instances).
+        # hits/hit_tokens count APPLIED seeds (mark_seeded), not mere matches —
+        # a match the slot rewind already covered served nothing from the pool
+        # and must not inflate the reuse ratio (mark_unused counts it aside).
+        self.hits = 0
+        self.misses = 0
+        self.unused_hits = 0
+        self.hit_tokens = 0
+        self.evicted_blocks = 0
+        self.prompt_tokens = 0  # all prompt tokens seen by lookup()
+
+    # ------------------------------------------------------------------
+    # lookup / release
+    # ------------------------------------------------------------------
+
+    def lookup(self, prompt: list[int], cap: int | None = None
+               ) -> PrefixLease | None:
+        """Longest cached block-prefix of `prompt`, as an acquired lease.
+
+        The reuse length is capped at len(prompt) - 1 (the last prompt token
+        must be re-inferred for logits, same rule as the reference NaiveCache)
+        and at `cap` (callers pass seq_len - 1). Returns None on a miss; on a
+        match the lease's nodes are acquired and MUST be handed back exactly
+        once: mark_seeded (the caller applied the rows) + release at request
+        end, or mark_unused (discarded). No block data is read here — callers
+        decide whether the lease beats their own rewind first, then fetch():
+        a discarded match must not pay the row gather."""
+        with self._lock:
+            self.prompt_tokens += len(prompt)
+            nodes = self.radix.match(prompt)
+            n = len(nodes) * self.block_tokens
+            n = min(n, len(prompt) - 1)
+            if cap is not None:
+                n = min(n, cap)
+            if n < 1:
+                self.misses += 1
+                _MISSES.inc()
+                return None
+            nodes = nodes[:(n + self.block_tokens - 1) // self.block_tokens]
+            self.radix.acquire(nodes)
+        return PrefixLease(nodes, n)
+
+    def fetch(self, lease: PrefixLease, skip: int = 0
+              ) -> tuple[np.ndarray, np.ndarray]:
+        """Gather the lease's rows [skip, lease.tokens) as (K, V) host arrays
+        of shape (L, hk, lease.tokens - skip, hs), ready to scatter into a
+        slot's cache rows (`skip` = what the slot's own rewind already holds).
+
+        Runs OUTSIDE the facade lock: a cold fetch dequantizes Q80 buffers,
+        which must not stall concurrent lookups/inserts. The lease's refs pin
+        the blocks (free() only happens via radix eviction, which respects
+        refs), the caller owns the lease exclusively, and pool.get tolerates
+        a concurrent demotion."""
+        bt = self.block_tokens
+        first = skip // bt
+        parts = [self.pool.get(node.handle) for node in lease.nodes[first:]]
+        k = np.concatenate([p[0] for p in parts], axis=2)
+        v = np.concatenate([p[1] for p in parts], axis=2)
+        off = skip - first * bt
+        end = off + (lease.tokens - skip)
+        return k[:, :, off:end], v[:, :, off:end]
+
+    def mark_seeded(self, lease: PrefixLease, used_tokens: int) -> None:
+        """The caller scattered this lease's rows into a slot: count the hit.
+        `used_tokens` is what the pool actually served — the seeded span
+        beyond whatever the slot's own rewind already covered."""
+        with self._lock:
+            self.hits += 1
+            self.hit_tokens += used_tokens
+        _HITS.inc()
+        _HIT_TOKENS.inc(used_tokens)
+
+    def mark_unused(self, lease: PrefixLease | None) -> None:
+        """The caller discarded the lease without applying it (the slot/
+        resident rewind already covered the matched prefix, or the seed copy
+        failed): releases it and counts it aside from the hit ratio."""
+        if lease is None:
+            return
+        with self._lock:
+            self.unused_hits += 1
+        _UNUSED.inc()
+        self.release(lease)
+
+    def release(self, lease: PrefixLease | None) -> None:
+        if lease is None:
+            return
+        with self._lock:
+            # take-and-clear under the lock: two racing releasers (e.g.
+            # BatchEngine.close() vs a scheduler thread alive past the join
+            # timeout) must not double-decrement the refcounts
+            nodes, lease.nodes = lease.nodes, []
+            lease.tokens = 0
+            if nodes:
+                self.radix.release(nodes)
+
+    def shrink(self, lease: PrefixLease, n_tokens: int) -> None:
+        """Truncate a lease to `n_tokens`: blocks no part of [0, n_tokens)
+        touches are released (the scheduler truncated the slot's reusable
+        history — e.g. a clamped park overwrote its tail rows — so the pin
+        on the now-irrelevant tail must not block eviction)."""
+        if n_tokens >= lease.tokens:
+            return
+        keep = (max(n_tokens, 0) + self.block_tokens - 1) // self.block_tokens
+        with self._lock:  # same take-and-clear discipline as release()
+            drop, lease.nodes = lease.nodes[keep:], lease.nodes[:keep]
+            lease.tokens = max(n_tokens, 0)
+            if drop:
+                self.radix.release(drop)
+
+    # ------------------------------------------------------------------
+    # insert
+    # ------------------------------------------------------------------
+
+    def insert(self, tokens: list[int], harvest) -> int:
+        """Commit `tokens`' full blocks; returns how many NEW blocks landed.
+
+        `harvest(t0, t1) -> (k, v)` supplies the (L, hk, t1-t0, hs) rows for
+        token positions [t0, t1) — called at most ONCE, for the whole missing
+        suffix (missing blocks are always a suffix: prefix-closed tree), so a
+        device harvest pays one transfer however many blocks it fills. Tokens
+        past the last full block are dropped (a partial block has no home)."""
+        bt = self.block_tokens
+        n_blocks = len(tokens) // bt
+        blocked = tokens[:n_blocks * bt]
+        if n_blocks == 0:
+            return 0
+        with self._lock:
+            prefix_nodes = self.radix.match(blocked)
+            have = len(prefix_nodes)
+            if have >= n_blocks:
+                return 0
+            # pin the existing prefix: the harvest below runs OUTSIDE the lock
+            # (it is a device->host transfer — holding the lock across it
+            # would stall every concurrent lookup), and the batched eviction
+            # further down must never take this chain's own ancestors
+            self.radix.acquire(prefix_nodes)
+        created = 0
+        try:
+            k_rows, v_rows = harvest(have * bt, n_blocks * bt)
+            with self._lock:
+                # a concurrent insert of the same prefix may have landed
+                # blocks meanwhile; radix.insert skips them (harvest offsets
+                # stay keyed to `have` — the pinned prefix cannot shrink)
+                missing = n_blocks - len(self.radix.match(blocked))
+                room = self.pool.max_blocks - len(self.pool)
+                if room < missing:
+                    # one batched eviction for the whole deficit instead of a
+                    # full-tree sweep per block
+                    freed = self.radix.evict(missing - room)
+                    for h in freed:
+                        self.pool.free(h)
+                    self.evicted_blocks += len(freed)
+                    _EVICTED.inc(len(freed))
+
+                def make_handle(i: int) -> int | None:
+                    nonlocal created
+                    lo = (i - have) * bt
+                    h = self.pool.put(k_rows[:, :, lo:lo + bt],
+                                      v_rows[:, :, lo:lo + bt])
+                    if h is not None:  # None: leases pinned the whole pool
+                        created += 1
+                    return h
+
+                self.radix.insert(blocked, make_handle)
+        finally:
+            with self._lock:
+                self.radix.release(prefix_nodes)
+        _INSERTED.inc(created)
+        self._publish_gauges()
+        return created
+
+    def total_refs(self) -> int:
+        """Live reservation count, read under the lock (a scheduler-thread
+        insert may be mutating the tree concurrently)."""
+        with self._lock:
+            return self.radix.total_refs()
+
+    # ------------------------------------------------------------------
+    # stats
+    # ------------------------------------------------------------------
+
+    def _publish_gauges(self) -> None:
+        # under the lock: hot_count/nbytes iterate the pool's block dict,
+        # which a concurrent insert/evict mutates
+        with self._lock:
+            blocks, hot = len(self.pool), self.pool.hot_count()
+            nbytes, nodes = self.pool.nbytes(), self.radix.nodes
+        _POOL_BLOCKS.set(blocks)
+        _POOL_HOT.set(hot)
+        _POOL_BYTES.set(nbytes)
+        _TREE_NODES.set(nodes)
+
+    def stats(self) -> dict:
+        """JSON-able snapshot (bench output, /v1/stats)."""
+        with self._lock:
+            looked = self.hits + self.unused_hits + self.misses
+            return {
+                "hits": self.hits, "misses": self.misses,
+                "unused_hits": self.unused_hits,
+                "hit_tokens": self.hit_tokens,
+                "prompt_tokens": self.prompt_tokens,
+                "hit_rate": (self.hit_tokens / self.prompt_tokens
+                             if self.prompt_tokens else 0.0),
+                "lookup_hit_rate": ((self.hits + self.unused_hits) / looked
+                                    if looked else 0.0),
+                "evicted_blocks": self.evicted_blocks,
+                "pool_blocks": len(self.pool),
+                "pool_hot_blocks": self.pool.hot_count(),
+                "pool_capacity_blocks": self.pool.max_blocks,
+                "pool_bytes": self.pool.nbytes(),
+                "demoted_blocks": self.pool.demoted_blocks,
+                "tree_nodes": self.radix.nodes,
+                "block_tokens": self.block_tokens,
+                "q80_tier": self.pool.q80,
+            }

@@ -1,0 +1,133 @@
+"""Single-slot (Engine) client of the shared-prefix cache.
+
+Supersedes api_server's NaiveCache: the same resident-conversation rewind the
+reference implements (dllama-api.cpp:187-232) PLUS the cross-conversation
+radix path — after conversation A is displaced by conversation B, a return to
+A (or any prompt sharing A's system-prompt blocks) seeds the engine cache
+from the pool instead of re-prefilling.
+
+Two reuse sources, best wins:
+- resident: the engine's live KV still holds the previous conversation;
+  longest common token prefix rewinds `pos` (Engine.seek) — token-granular,
+  zero copies, works in every engine mode including paged.
+- radix: cached blocks cover a longer prefix than the resident KV does; the
+  rows beyond the resident-common point are copied into the engine cache and
+  `pos` moves FORWARD to the seeded length. Plain (non-paged) engines only:
+  the paged ring's slot-position formula has no notion of rows that were
+  never appended to the host store, so paged engines keep resident-only
+  semantics (exactly the old NaiveCache).
+
+The API server's generation lock serializes callers, so begin/end pairs never
+interleave; the PrefixCache itself is still internally locked (it may be
+shared with other clients).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..obs import trace
+from .prefix_cache import PrefixCache, PrefixLease
+
+__all__ = ["SingleSlotCache"]
+
+
+class SingleSlotCache:
+    def __init__(self, engine, cache: PrefixCache | None):
+        self.engine = engine
+        # paged mode: resident-only (see module docstring)
+        self.cache = None if (cache is None or engine.paged) else cache
+        self.resident: list[int] = []  # tokens whose KV the engine holds
+        self._lease: PrefixLease | None = None
+
+    def _resident_common(self, prompt: list[int]) -> int:
+        n = 0
+        for a, b in zip(self.resident, prompt):
+            if a != b:
+                break
+            n += 1
+        # never reuse the full prompt — the last token must be re-inferred
+        return min(n, max(len(prompt) - 1, 0))
+
+    def begin(self, prompt: list[int]) -> int:
+        """Prepare the engine for `prompt`; returns how many leading tokens are
+        already in its KV (the caller prefills only prompt[reuse:])."""
+        eng = self.engine
+        reuse = self._resident_common(prompt)
+        if self.cache is not None:
+            cap = eng.spec.seq_len - 1
+            lease = self.cache.lookup(prompt, cap=cap)
+            if lease is not None and lease.tokens > reuse:
+                try:
+                    with trace.span("api.prefix_seed",
+                                    {"tokens": lease.tokens,
+                                     "resident": reuse}):
+                        eng.seek(min(reuse, eng.pos))
+                        # fetch only beyond the resident rows; broadcast over
+                        # the batch axis — the single-slot host loop tiles one
+                        # sequence across every cache row
+                        ck, cv = self.cache.fetch(lease, skip=reuse)
+                        kk = np.asarray(ck[:, None], eng.k_cache.dtype)
+                        vv = np.asarray(cv[:, None], eng.v_cache.dtype)
+                        eng.k_cache = eng.k_cache.at[
+                            :, :, :, reuse:lease.tokens, :].set(kk)
+                        eng.v_cache = eng.v_cache.at[
+                            :, :, :, reuse:lease.tokens, :].set(vv)
+                        eng.pos = lease.tokens  # forward "seek": rows now exist
+                except Exception as e:
+                    # a partial write may have corrupted rows >= reuse of the
+                    # RESIDENT conversation too — truncate the reuse record to
+                    # the rows still known-good and fall back to plain prefill
+                    # (the cache is an optimization, never a correctness gate)
+                    self.cache.mark_unused(lease)
+                    self.resident = self.resident[:reuse]
+                    from . import warn_degraded
+
+                    warn_degraded("seed", e)  # fall back to full prefill
+                    eng.seek(min(reuse, eng.pos))
+                    return reuse
+                self._lease = lease
+                self.cache.mark_seeded(lease, lease.tokens - reuse)
+                self.resident = list(prompt[:lease.tokens])
+                return lease.tokens
+            self.cache.mark_unused(lease)
+        eng.seek(min(reuse, eng.pos))
+        return reuse
+
+    def end(self, committed: list[int]) -> None:
+        """Record the finished request's engine-resident tokens and harvest
+        their full blocks into the pool. `committed` must be exactly the
+        tokens whose KV is written — (prompt + out)[:engine.pos]."""
+        eng = self.engine
+        try:
+            if self.cache is not None and committed:
+                def harvest(t0: int, t1: int):
+                    # row 0 of the (tiled) batch holds the sequence
+                    return (np.asarray(eng.k_cache[:, 0, :, t0:t1]),
+                            np.asarray(eng.v_cache[:, 0, :, t0:t1]))
+
+                with trace.span("api.prefix_insert",
+                                {"tokens": len(committed)}):
+                    self.cache.insert(committed, harvest)
+        except Exception as e:
+            # the generation SUCCEEDED — a failed harvest must neither fail
+            # the request nor leak the lease (an unreleased lease pins its
+            # blocks unevictably forever)
+            from . import warn_degraded
+
+            warn_degraded("insert", e)
+        finally:
+            if self.cache is not None:
+                self.cache.release(self._lease)
+            self._lease = None
+            self.resident = list(committed)
+
+    def invalidate(self) -> None:
+        """Generation failed mid-write: the engine KV is not trustworthy."""
+        if self.cache is not None:
+            self.cache.release(self._lease)
+        self._lease = None
+        self.resident = []
+
+    def stats(self) -> dict | None:
+        return self.cache.stats() if self.cache is not None else None

@@ -37,6 +37,11 @@ Design:
   bit-exactly the host loop's tokens.
 - Per-slot NaiveCache prefix reuse (dllama-api.cpp:187-232): a new request lands on the
   free slot sharing the longest token prefix and rewinds instead of re-prefilling.
+- CROSS-REQUEST prefix reuse (cache/, docs/PREFIX_CACHE.md): a finished slot's
+  committed prefix is harvested into a radix-indexed block pool; a new request
+  whose prompt shares cached blocks — on ANY slot — seeds its cache rows + pos
+  from the pool and prefills only the uncached suffix. The same-slot rewind
+  above remains as the token-granular (and copy-free) fast path.
 """
 
 from __future__ import annotations
@@ -93,6 +98,10 @@ _DECODE_TOKENS = metrics.counter(
 _REQUESTS = metrics.counter(
     "batch_requests_total", "Completed requests by finish reason",
     labelnames=("finish",))
+_PREFIX_SEEDED = metrics.counter(
+    "batch_prefix_seeded_tokens_total",
+    "Cache rows copied from the prefix-cache pool at admission "
+    "(prompt tokens whose prefill was skipped beyond the same-slot rewind)")
 
 
 @dataclass
@@ -136,6 +145,14 @@ class _Slot:
         # token already sampled (on device, tail of a super-step block) but not
         # yet ingested — consumed by _advance_row instead of a host sample
         self.next_token: int | None = None
+        # prefix-cache lease pinning the blocks this slot was seeded from
+        # (released at _finish; shrunk when history is truncated)
+        self.lease = None
+        # set BEFORE a super-step's delivery loop when the scan will park
+        # this row clamped at seq_len-1 (destroying that history row): a
+        # mid-loop _finish must harvest the TRUNCATED history, not the
+        # poisoned row (consumed by _harvest_into_cache / the post-loop clamp)
+        self.clamp_pos: int | None = None
 
 
 class BatchEngine:
@@ -147,7 +164,9 @@ class BatchEngine:
     """
 
     def __init__(self, spec: ModelSpec, params, tokenizer=None, *, slots: int = 2,
-                 superstep: int = 8, **engine_kw):
+                 superstep: int = 8, prefix_cache=True,
+                 prefix_cache_blocks: int = 0, prefix_block_tokens: int = 16,
+                 prefix_cache_q80: bool = False, **engine_kw):
         from .engine import Engine
 
         assert slots >= 1
@@ -186,6 +205,19 @@ class BatchEngine:
         self._shutdown = False
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # Cross-request prefix cache (cache/): pass False to disable, True for
+        # defaults, or a ready PrefixCache instance to share one across
+        # engines. Paged engines are excluded — their ring layout has no
+        # plain [0, n) row prefix to seed.
+        self.prefix_cache = None
+        if not self._eng.paged:
+            from ..cache import make_prefix_cache
+
+            self.prefix_cache = make_prefix_cache(
+                self._eng.k_cache.shape, self._eng.k_cache.dtype.itemsize,
+                slots=slots, prefix_cache=prefix_cache,
+                blocks=prefix_cache_blocks, block_tokens=prefix_block_tokens,
+                q80=prefix_cache_q80)
         _SLOTS_TOTAL.set(slots)
 
     @classmethod
@@ -240,6 +272,9 @@ class BatchEngine:
         err = RuntimeError("BatchEngine closed")
         with self._plock:
             for s in self._slots:
+                if self.prefix_cache is not None and s.lease is not None:
+                    self.prefix_cache.release(s.lease)
+                    s.lease = None
                 req = s.req
                 if req is not None and not req.done.is_set():
                     req.error = err
@@ -269,7 +304,11 @@ class BatchEngine:
 
     def _assign(self, req: BatchRequest) -> _Slot | None:
         """Place a request on the free slot with the longest common token prefix
-        (the multi-slot generalization of the reference NaiveCache)."""
+        (the multi-slot generalization of the reference NaiveCache), then try
+        to extend the reuse from the cross-request prefix cache: when the radix
+        index covers more of the prompt than the slot's own history, the extra
+        rows are copied in from the block pool and prefill starts at the seeded
+        position (docs/PREFIX_CACHE.md)."""
         free = [s for s in self._slots if s.req is None]
         if not free:
             return None
@@ -282,16 +321,57 @@ class BatchEngine:
             return min(n, len(req.prompt) - 1)
         best = max(free, key=common)
         reuse = common(best)
+        if self.prefix_cache is not None:
+            reuse = self._seed_from_cache(best, req, reuse)
         best.req = req
         best.pos = reuse
-        best.history = best.history[:reuse]
+        best.history = list(req.prompt[:reuse])
         best.pending = req.prompt[reuse:]
         best.last_logits = None
         best.next_token = None
+        best.clamp_pos = None
         req.stats.prompt_tokens = len(req.prompt)
         if req.submit_t:
             _QUEUE_WAIT.observe(time.perf_counter() - req.submit_t)
         return best
+
+    def _seed_from_cache(self, slot: _Slot, req: BatchRequest,
+                         reuse: int) -> int:
+        """Consult the radix index for req.prompt; when it beats the same-slot
+        rewind, scatter the pool blocks' rows into the slot's cache rows
+        [reuse, n) and return the seeded length n (the new prefill start).
+        The acquired lease stays on the slot until _finish (eviction must
+        respect in-flight slots); seeding failures fall back to plain
+        prefill — the cache is an optimization, never a correctness gate."""
+        lease = self.prefix_cache.lookup(req.prompt,
+                                         cap=self.spec.seq_len - 1)
+        if lease is None:
+            return reuse
+        if lease.tokens <= reuse:
+            self.prefix_cache.mark_unused(lease)
+            return reuse
+        eng = self._eng
+        n = lease.tokens
+        try:
+            with trace.span("batch.prefix_seed",
+                            {"slot": slot.index, "tokens": n,
+                             "rewind": reuse}):
+                # fetch only the span the rewind doesn't already hold
+                ck, cv = self.prefix_cache.fetch(lease, skip=reuse)
+                eng.k_cache = eng.k_cache.at[:, slot.index, :, reuse:n, :].set(
+                    jnp.asarray(np.ascontiguousarray(ck), eng.dtype))
+                eng.v_cache = eng.v_cache.at[:, slot.index, :, reuse:n, :].set(
+                    jnp.asarray(np.ascontiguousarray(cv), eng.dtype))
+        except Exception as e:
+            self.prefix_cache.mark_unused(lease)
+            from ..cache import warn_degraded
+
+            warn_degraded("seed", e)  # fall back to full prefill
+            return reuse
+        slot.lease = lease
+        self.prefix_cache.mark_seeded(lease, n - reuse)
+        _PREFIX_SEEDED.inc(n - reuse)
+        return n
 
     def _step(self, tokens_rows: list[list[int]], starts: list[int], t: int):
         """Run one batched (B, t) step; returns logits (B, t, vocab) np.ndarray."""
@@ -310,8 +390,61 @@ class BatchEngine:
         slot.req = None
         slot.pending = []
         slot.next_token = None
+        if self.prefix_cache is not None and slot.lease is not None:
+            # the lease pins blocks for the IN-FLIGHT period only; release
+            # before done.set() so a caller observing completion sees no
+            # residual reservation (the harvest below re-walks the tree and
+            # needs no pin — insert guards its own chain)
+            self.prefix_cache.release(slot.lease)
+            slot.lease = None
         _REQUESTS.labels(finish=finish).inc()
         req.done.set()
+        # harvest AFTER done.set(): the slot's history/rows stay valid (they
+        # also back the same-slot rewind), and the copy-out must not extend
+        # the finished client's wait
+        if self.prefix_cache is not None:
+            self._harvest_into_cache(slot)
+
+    def _harvest_into_cache(self, slot: _Slot) -> None:
+        """Copy the finished slot's committed prefix into the block pool (the
+        cross-request half of prefix reuse). history's rows [0, len(history))
+        are committed by construction — every truncation site shrinks history
+        before the rows are overwritten."""
+        pc = self.prefix_cache
+        if slot.clamp_pos is not None:
+            # the in-flight super-step parked this row clamped at clamp_pos,
+            # destroying that row — drop it from the harvestable history NOW
+            # (the post-loop truncation would run too late for this harvest)
+            self._truncate_history(slot, slot.clamp_pos)
+            slot.clamp_pos = None
+        try:
+            if len(slot.history) >= pc.block_tokens:
+                eng = self._eng
+
+                def harvest(t0: int, t1: int):
+                    return (np.asarray(eng.k_cache[:, slot.index, :, t0:t1]),
+                            np.asarray(eng.v_cache[:, slot.index, :, t0:t1]))
+
+                with trace.span("batch.prefix_insert",
+                                {"slot": slot.index,
+                                 "tokens": len(slot.history)}):
+                    pc.insert(slot.history, harvest)
+        except Exception as e:  # a failed insert must not kill the scheduler
+            from ..cache import warn_degraded
+
+            warn_degraded("insert", e)
+
+    def _truncate_history(self, sl: _Slot, p: int) -> None:
+        """Truncate a slot's reusable history to p tokens — its rows >= p are
+        (about to be) overwritten by clamped scratch writes — and shrink any
+        prefix-cache lease past p. Without the shrink a clamped park would
+        leave the radix reservation pinning blocks for a prefix the slot no
+        longer holds, blocking their eviction until _finish (and lying about
+        what the slot can re-insert)."""
+        if p < len(sl.history):
+            sl.history = sl.history[:p]
+        if sl.lease is not None and p < sl.lease.tokens:
+            self.prefix_cache.shrink(sl.lease, p)
 
     def _park_positions(self, t: int) -> list[int]:
         """Per-row start positions for rows not participating in this step: park at the
@@ -324,7 +457,7 @@ class BatchEngine:
         for sl in self._slots:
             p = min(sl.pos, max(s - t, 0))
             if p < sl.pos:
-                sl.history = sl.history[:p]
+                self._truncate_history(sl, p)
             starts.append(p)
         return starts
 
@@ -609,6 +742,13 @@ class BatchEngine:
             req = slot.req
             i = slot.index
             b = budget[i]
+            if b < k and starts[i] + b >= s:
+                # the scan parked this row mid-block clamped at s-1, whose
+                # scratch writes destroyed that history row — record it BEFORE
+                # delivery: reaching pos == s finishes the request inside the
+                # loop below, and that _finish's harvest must not commit the
+                # poisoned row (_harvest_into_cache consumes clamp_pos)
+                slot.clamp_pos = s - 1
             block = toks[:b, i].tolist()
             smp = req.sampler
             state0 = int(getattr(smp, "state", 0))
@@ -662,8 +802,9 @@ class BatchEngine:
                 # block fully delivered; its tail is sampled but not ingested
                 slot.next_token = block[-1]
                 slot.last_logits = None
-            if b < k and starts[i] + b >= s:
-                # the row parked mid-scan at the clamped position s-1, so its
-                # scratch write destroyed that history row (mirror of the
-                # _park_positions clamp truncation)
-                slot.history = slot.history[:s - 1]
+            if slot.clamp_pos is not None:
+                # row did not finish mid-loop (the harvest consumes clamp_pos
+                # when it did): apply the clamp truncation here — mirror of
+                # the _park_positions clamp, incl. the lease shrink
+                self._truncate_history(slot, slot.clamp_pos)
+                slot.clamp_pos = None

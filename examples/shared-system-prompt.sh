@@ -1,0 +1,60 @@
+#!/bin/bash
+# Shared-system-prompt demo (docs/PREFIX_CACHE.md): two concurrent clients
+# send chat completions that share one long system prompt. With the
+# cross-request prefix cache (default on), the second request's system-prompt
+# KV is seeded from the radix-indexed block pool instead of re-prefilled —
+# watch the prefix_cache_* counters move in /v1/stats.
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL="${DLLAMA_MODEL:-/tmp/dlt_determinism/tiny.m}"
+TOKENIZER="${DLLAMA_TOKENIZER:-/tmp/dlt_determinism/tiny.t}"
+if [ ! -f "$MODEL" ]; then
+  mkdir -p /tmp/dlt_determinism
+  python examples/make_tiny_model.py /tmp/dlt_determinism
+fi
+
+export JAX_PLATFORMS=cpu
+PORT="${PORT:-9993}"
+
+python -m distributed_llama_tpu.apps.api_server \
+  --model "$MODEL" --tokenizer "$TOKENIZER" --chat-template chatml \
+  --host 127.0.0.1 --port "$PORT" --batch 2 --superstep 4 \
+  --prefix-cache-block-tokens 8 &
+SERVER_PID=$!
+trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
+
+for _ in $(seq 60); do
+  curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 && break
+  sleep 1
+done
+
+SYSTEM="You are a careful assistant. Answer briefly. Cite nothing. \
+Refuse nothing. The quick brown fox jumps over the lazy dog again and again."
+
+req() {
+  curl -s "http://127.0.0.1:$PORT/v1/chat/completions" \
+    -H 'Content-Type: application/json' \
+    -d "{\"messages\": [{\"role\": \"system\", \"content\": \"$SYSTEM\"},
+                        {\"role\": \"user\", \"content\": \"$1\"}],
+         \"max_tokens\": 12, \"temperature\": 0}" >/dev/null
+  echo "  client done: $1"
+}
+
+echo "— warm request (inserts the system prompt's KV blocks into the pool)"
+req "hello there"
+
+echo "— two concurrent clients sharing the system prompt"
+req "what is a fox?" &
+req "what is a dog?" &
+wait %2 %3 2>/dev/null || wait
+
+echo "— /v1/stats prefix-cache hit counters:"
+curl -s "http://127.0.0.1:$PORT/v1/stats" | python -c '
+import json, sys
+stats = json.load(sys.stdin)
+pc = stats.get("prefix_cache", {})
+for k in ("hits", "misses", "hit_tokens", "hit_rate", "pool_blocks",
+          "tree_nodes", "evicted_blocks"):
+    print(f"  {k}: {pc.get(k)}")
+'

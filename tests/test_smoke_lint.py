@@ -20,6 +20,19 @@ def test_repo_compiles_and_no_dead_imports():
     assert not dead, "\n".join(dead)
 
 
+def test_scan_covers_cache_package():
+    """The prefix-cache subsystem (ISSUE 3) must ride the repo-wide compile +
+    dead-import gate like every other first-party package — a scan-root
+    regression would silently drop it from tier-1."""
+    files = smoke_lint.repo_py_files()
+    rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
+    for mod in ("radix", "block_pool", "prefix_cache", "single_slot",
+                "__init__"):
+        assert os.path.join("distributed_llama_tpu", "cache",
+                            f"{mod}.py") in rel, (mod, sorted(rel)[:5])
+    assert os.path.join("perf", "prefix_seed_bench.py") in rel
+
+
 def test_fallback_checker_flags_planted_dead_import(tmp_path):
     """The AST fallback actually detects the defect class it exists for,
     and respects the noqa escape hatch."""
