@@ -20,6 +20,7 @@ Usage: python bench.py [--small] [--steps N] [--tp N] [--layout i4p|i8]
                        [--device-loop N] [--window W]
                        [--batch B --superstep K]   (serving throughput mode)
                        [--workload shared-prefix]  (prefix-cache TTFT mode)
+                       [--workload chaos]          (fault-injection resilience mode)
 
 --workload shared-prefix drives the BatchEngine scheduler with a synthetic
 multi-request workload (one common system prompt + distinct user turns) twice
@@ -377,6 +378,100 @@ def shared_prefix_workload(args, spec):
     }))
 
 
+def chaos_workload(args, spec):
+    """--workload chaos: resilience cost of the unhappy path
+    (docs/ROBUSTNESS.md). The identical concurrent-request schedule runs
+    twice against one warmed BatchEngine — fault-free baseline, then with a
+    --fault-rate (default 1%) injected TRANSIENT failure probability on
+    every scheduler device dispatch (the retry-with-backoff path) — and
+    reports survivor aggregate throughput degradation plus per-request TTFT
+    p95 for both. Every request is expected to COMPLETE in both runs: a
+    transient fault is retried, not surfaced; completion counts are emitted
+    so a retry-path regression shows up as failed_requests > 0."""
+    from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.quants import FloatType as _FTy
+    from distributed_llama_tpu.resilience import faults as _faults
+    from distributed_llama_tpu.resilience.faults import FaultSpec
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    n_req = max(args.requests, 2)
+    gen = 24  # decoded tokens per request
+    rng = np.random.default_rng(0)
+    prompts = [[1] + [int(t) for t in rng.integers(2, spec.vocab_size, 12)]
+               for _ in range(n_req)]
+    params = init_random_params(spec, _FTy.Q40, seed=0)
+    B = args.batch if args.batch > 0 else min(max(n_req // 2, 2), 8)
+    be = BatchEngine(spec, params, slots=B,
+                     superstep=max(args.superstep, 1), tp=args.tp)
+    out = {}
+    try:
+        # warm every compiled shape so both runs measure dispatch, not compile
+        be.generate(list(prompts[0]), gen,
+                    Sampler(spec.vocab_size, temperature=0.0))
+        for label in ("baseline", "chaos"):
+            plan = None
+            if label == "chaos":
+                plan = _faults.install(
+                    [FaultSpec("batch.dispatch", kind="transient",
+                               prob=args.fault_rate)], seed=7)
+            try:
+                ttfts, t0s, reqs = {}, {}, []
+
+                def on_tok(i):
+                    def cb(_t, i=i):
+                        if i not in ttfts:
+                            ttfts[i] = time.perf_counter() - t0s[i]
+                    return cb
+
+                t_all0 = time.perf_counter()
+                for i in range(n_req):
+                    t0s[i] = time.perf_counter()
+                    reqs.append(be.submit(
+                        list(prompts[i]), gen,
+                        Sampler(spec.vocab_size, temperature=0.0),
+                        on_token=on_tok(i)))
+                failed = 0
+                tokens = 0
+                for r in reqs:
+                    try:
+                        tokens += len(r.wait(timeout=600))
+                    except Exception:
+                        failed += 1
+                e2e = time.perf_counter() - t_all0
+            finally:
+                _faults.uninstall()
+            lat = sorted(ttfts.values())
+            out[label] = {
+                "tok_s": round(tokens / e2e, 3),
+                # None, not a crash, when every request died pre-first-token
+                # (e.g. --fault-rate 1.0 exhausts every dispatch's retries)
+                "ttft_p95_ms": round(
+                    lat[min(int(len(lat) * 0.95), len(lat) - 1)] * 1e3, 2)
+                if lat else None,
+                "failed_requests": failed,
+                "injected": plan.fired() if plan is not None else 0,
+            }
+    finally:
+        be.close()
+    base, chaos = out["baseline"], out["chaos"]
+    print(json.dumps({
+        "metric": "chaos_survivor_tok_s",
+        "value": chaos["tok_s"], "unit": "tok/s", "vs_baseline": None,
+        "baseline_tok_s": base["tok_s"],
+        "degradation_pct": round(
+            100.0 * (1.0 - chaos["tok_s"] / max(base["tok_s"], 1e-9)), 2),
+        "ttft_p95_ms": chaos["ttft_p95_ms"],
+        "ttft_p95_baseline_ms": base["ttft_p95_ms"],
+        "fault_rate": args.fault_rate,
+        "injected_faults": chaos["injected"],
+        "failed_requests": chaos["failed_requests"],
+        "failed_requests_baseline": base["failed_requests"],
+        "requests": n_req, "gen_tokens": gen, "batch": B,
+        "superstep": max(args.superstep, 1),
+    }))
+
+
 def vs_baseline(args, tok_s: float):
     """Ratio vs the reference's published number — which exists only for the
     Llama-2-7B single-node config (README.md:131). Other archs report null rather
@@ -485,10 +580,18 @@ def main():
     ap.add_argument("--prefill", type=int, default=0, metavar="T",
                     help="bench chunked prefill throughput at chunk size T instead "
                          "of decode")
-    ap.add_argument("--workload", choices=("shared-prefix",), default=None,
+    ap.add_argument("--workload", choices=("shared-prefix", "chaos"),
+                    default=None,
                     help="scenario mode: 'shared-prefix' drives the BatchEngine "
                          "with a common-system-prompt multi-request workload and "
-                         "reports TTFT p50/p95 + prefix_hit_rate, cache on vs off")
+                         "reports TTFT p50/p95 + prefix_hit_rate, cache on vs "
+                         "off; 'chaos' runs the same schedule fault-free vs "
+                         "with --fault-rate injected transient dispatch "
+                         "failures and reports survivor-throughput degradation "
+                         "+ TTFT p95 (docs/ROBUSTNESS.md)")
+    ap.add_argument("--fault-rate", type=float, default=0.01, metavar="P",
+                    help="chaos workload: per-dispatch transient-failure "
+                         "injection probability (retried by the scheduler)")
     ap.add_argument("--requests", type=int, default=5, metavar="N",
                     help="shared-prefix workload: total requests (1 warm + N-1 "
                          "concurrent followers)")
@@ -548,9 +651,9 @@ def main():
                  "only with --superstep/--steps/--arch/--layout/--tp")
     if args.workload and (args.prefill > 0 or args.device_loop > 0
                           or args.kv_paged > 0):
-        ap.error("--workload shared-prefix is its own mode; combine only with "
-                 "--small/--arch/--batch/--superstep/--requests/"
-                 "--shared-prefix/--tp")
+        ap.error(f"--workload {args.workload} is its own mode; combine only "
+                 "with --small/--arch/--batch/--superstep/--requests/"
+                 "--shared-prefix/--fault-rate/--tp")
     if args.kv_paged > 0 and args.tp > 1:
         # before any mesh/device work so the error beats a mesh-size crash
         ap.error("--kv-paged is single-chip (the paged step is an unsharded "
@@ -668,6 +771,9 @@ def main():
     spec = ModelSpec(**(SMALL if args.small else ARCHS[args.arch])).resolved()
     if args.workload == "shared-prefix":
         shared_prefix_workload(args, spec)
+        return
+    if args.workload == "chaos":
+        chaos_workload(args, spec)
         return
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     layout = args.layout if on_tpu else "planar"

@@ -26,6 +26,10 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..obs import metrics
+from ..resilience import faults
+from ..resilience.errors import (DeadlineExceeded, EngineClosed,
+                                 EngineDraining, EngineSaturated,
+                                 InvalidRequest)
 from ..runtime.engine import Engine
 from ..runtime.sampler import Sampler
 from ..tokenizer import ChatItem, ChatTemplate, EosDetector, TemplateType
@@ -61,10 +65,18 @@ class ApiState:
                  default_sampler: Sampler, device_loop_chunk: int = 0,
                  batch_engine=None, speculative_k: int = 0,
                  prefix_cache=True, prefix_cache_blocks: int = 0,
-                 prefix_block_tokens: int = 16, prefix_cache_q80: bool = False):
+                 prefix_block_tokens: int = 16, prefix_cache_q80: bool = False,
+                 request_deadline: float = 0.0):
         self.engine = engine
         self.batch_engine = batch_engine  # BatchEngine when --batch > 1, else None
         self.lock = threading.Lock()
+        # graceful drain (docs/ROBUSTNESS.md): set by begin_drain/SIGTERM —
+        # /healthz flips to 503 "draining", new completions are refused with
+        # EngineDraining (503), in-flight requests finish
+        self.draining = False
+        # server-side wall-clock deadline applied to every batched request
+        # (seconds; 0 = none) — the scheduler enforces it, finish "deadline"
+        self.request_deadline = request_deadline
         # single-slot prefix reuse (cache/single_slot.py, ex-NaiveCache): the
         # resident-conversation rewind plus the cross-conversation radix pool.
         # Batched mode needs neither — slot assignment and prefix reuse live
@@ -138,6 +150,10 @@ def _stats_payload(state: "ApiState") -> dict:
             "super_steps": be.super_steps,
             "mixed_steps": be.mixed_steps,
             "occupied": sum(1 for s in be._slots if s.req is not None),
+            "scheduler_alive": be.scheduler_alive(),
+            "draining": be.draining,
+            "max_queue": be.max_queue,
+            "queue_ttl": be.queue_ttl,
         }
     elif state.engine is not None:
         eng = state.engine
@@ -161,7 +177,14 @@ def _observe_done(t_start: float, ttft: list, n_tokens: int) -> None:
 
 
 def run_completion(state: ApiState, body: dict, emit):
-    """Shared completion core. `emit(text_delta)` streams; returns (text, finish)."""
+    """Shared completion core. `emit(text_delta)` streams; returns (text, finish).
+
+    Raises typed resilience errors BEFORE any generation work so the HTTP
+    layer can map them to honest status codes (InvalidRequest -> 400,
+    EngineDraining/EngineSaturated -> 503, DeadlineExceeded -> 408)."""
+    faults.fire("api.request")
+    if state.draining:
+        raise EngineDraining("server is draining (shutting down)")
     t_start = time.perf_counter()
     ttft: list = [None]
     user_emit = emit
@@ -180,13 +203,25 @@ def run_completion(state: ApiState, body: dict, emit):
     rendered = state.template.generate(messages)
     prompt = tok.encode(rendered, add_bos=True)
 
+    # request validation (docs/ROBUSTNESS.md): caller errors must be 400s,
+    # never a 500 or a stall. A prompt at/over seq_len has no room to decode
+    # even one token; max_tokens must be a non-negative integer (explicit 0 /
+    # null keep the fill-the-context default, OpenAI null semantics).
+    if len(prompt) >= spec.seq_len:
+        raise InvalidRequest(
+            f"prompt is {len(prompt)} tokens but the model context is "
+            f"{spec.seq_len}; reduce the conversation or raise --max-seq-len")
+    mt_raw = _opt(body, "max_tokens", 0)
+    if isinstance(mt_raw, bool) or not isinstance(mt_raw, int) or mt_raw < 0:
+        raise InvalidRequest(
+            f"'max_tokens' must be a non-negative integer, got {mt_raw!r}")
     sampler = Sampler(
         spec.vocab_size,
         float(_opt(body, "temperature", state.default_sampler.temperature)),
         float(_opt(body, "top_p", state.default_sampler.topp)),
         int(_opt(body, "seed", _now())),
     )
-    max_tokens = int(_opt(body, "max_tokens", 0)) or (spec.seq_len - len(prompt))
+    max_tokens = mt_raw or (spec.seq_len - len(prompt))
 
     stops = tok.chat_stops()
     stop_param = _opt(body, "stop", [])
@@ -214,9 +249,10 @@ def run_completion(state: ApiState, body: dict, emit):
 
         qstreamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t),
                                   emit_queued)
-        req = state.batch_engine.submit(prompt, max_tokens, sampler,
-                                        on_token=qstreamer.on_token,
-                                        stop_check=qstreamer.stop_check)
+        req = state.batch_engine.submit(
+            prompt, max_tokens, sampler, on_token=qstreamer.on_token,
+            stop_check=qstreamer.stop_check,
+            deadline=state.request_deadline or None)
         # sentinel closes the drain loop the moment the request completes (the puts
         # happen-before done.set(), so everything queued is drained first)
         threading.Thread(target=lambda: (req.done.wait(), deltas.put(None)),
@@ -233,6 +269,10 @@ def run_completion(state: ApiState, body: dict, emit):
             raise req.error
         if qstreamer.stopped:
             finish[0] = "stop"
+        elif req.finish == "deadline":
+            # deadline expired mid-generation WITH partial output: deliver
+            # what exists, finish_reason says why it stopped early
+            finish[0] = "deadline"
         _observe_done(t_start, ttft, req.stats.generated_tokens)
         return "".join(pieces), finish[0]
 
@@ -244,6 +284,22 @@ def run_completion(state: ApiState, body: dict, emit):
         emit(text)
 
     streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
+    # single-engine counterpart of the scheduler-enforced deadline: checked
+    # per decoded token via stop_check, finish reason "deadline", partial
+    # output delivered (granularity one token vs the scheduler's ~one
+    # dispatch; generation time only — the do_POST lock wait precedes
+    # t_start in this mode)
+    deadline_t = (t_start + state.request_deadline
+                  if state.request_deadline else None)
+
+    def stop_or_deadline(t):
+        if streamer.stop_check(t):
+            return True
+        if deadline_t is not None and time.perf_counter() >= deadline_t:
+            finish[0] = "deadline"
+            return True
+        return False
+
     # Prefix reuse (cache/single_slot.py): rewind pos over the resident
     # conversation's common prefix (for paged engines, begin() also restores
     # the hot ring from the host store via Engine.seek) and/or seed cache rows
@@ -254,7 +310,7 @@ def run_completion(state: ApiState, body: dict, emit):
     try:
         out, _stats = engine.generate_with(delta_prompt, max_tokens, sampler,
                                            on_token=streamer.on_token,
-                                           stop_check=streamer.stop_check,
+                                           stop_check=stop_or_deadline,
                                            device_loop_chunk=state.device_loop_chunk,
                                            speculative_k=state.speculative_k,
                                            # full conversation (incl. the reused
@@ -276,27 +332,58 @@ def run_completion(state: ApiState, body: dict, emit):
     return "".join(pieces), finish[0]
 
 
+def _map_error(e: Exception) -> tuple[int, str, float | None]:
+    """Typed resilience error -> (status, OpenAI error type, Retry-After).
+
+    InvalidRequest subclasses ValueError, so the isinstance order matters:
+    the specific mappings come first and a bare ValueError (template/encode
+    failures on caller input) stays a 400."""
+    if isinstance(e, EngineSaturated):
+        return 503, "overloaded_error", getattr(e, "retry_after", 1.0)
+    if isinstance(e, EngineClosed):  # covers EngineDraining
+        return 503, "server_shutting_down", None
+    if isinstance(e, DeadlineExceeded):
+        return 408, "timeout_error", None
+    if isinstance(e, ValueError):  # covers InvalidRequest
+        return 400, "invalid_request_error", None
+    return 500, "server_error", None
+
+
 class Handler(BaseHTTPRequestHandler):
     state: ApiState  # injected
 
     def log_message(self, fmt, *args):  # quieter logs, reference prints per request
         print(f"🔷 {self.command} {self.path}")
 
-    def _raw(self, code: int, content_type: str, data: bytes):
+    def _raw(self, code: int, content_type: str, data: bytes,
+             extra_headers: dict | None = None):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
         _count_http(self.path, code)
 
-    def _json(self, code: int, payload: dict):
-        self._raw(code, "application/json", json.dumps(payload).encode())
+    def _json(self, code: int, payload: dict,
+              extra_headers: dict | None = None):
+        self._raw(code, "application/json", json.dumps(payload).encode(),
+                  extra_headers)
 
-    def _error(self, code: int, message: str, etype: str):
+    def _error(self, code: int, message: str, etype: str,
+               retry_after: float | None = None):
         """OpenAI-style error body: {"error": {"message", "type"}} — clients
-        built against the OpenAI SDK parse this shape, not bare strings."""
-        self._json(code, {"error": {"message": message, "type": etype}})
+        built against the OpenAI SDK parse this shape, not bare strings.
+        Load-shed 503s carry Retry-After so clients back off instead of
+        hammering a saturated queue."""
+        hdrs = ({"Retry-After": str(max(int(retry_after + 0.5), 1))}
+                if retry_after is not None else None)
+        self._json(code, {"error": {"message": message, "type": etype}}, hdrs)
+
+    def _mapped_error(self, e: Exception):
+        code, etype, retry_after = _map_error(e)
+        self._error(code, str(e), etype, retry_after)
 
     def do_GET(self):
         if self.path == "/v1/models":
@@ -304,9 +391,19 @@ class Handler(BaseHTTPRequestHandler):
                 {"id": self.state.model_name, "object": "model",
                  "created": _now(), "owned_by": "user"}]})
         elif self.path in ("/health", "/healthz"):
-            # load-balancer probe: cheap, no device work, 200 iff the process
-            # is serving (scheduler liveness is visible in /metrics instead)
-            self._json(200, {"status": "ok"})
+            # load-balancer probe: cheap, no device work. 200 while serving;
+            # 503 "draining" once SIGTERM/begin_drain flips the state (the
+            # LB stops routing while in-flight requests finish) and 503
+            # "unhealthy" when the batch scheduler thread died.
+            be = self.state.batch_engine
+            alive = be is None or be.scheduler_alive()
+            if self.state.draining or (be is not None and be.draining):
+                self._json(503, {"status": "draining"})
+            elif not alive:
+                self._json(503, {"status": "unhealthy",
+                                 "reason": "scheduler thread dead"})
+            else:
+                self._json(200, {"status": "ok"})
         elif self.path == "/metrics":
             self._raw(200, "text/plain; version=0.0.4; charset=utf-8",
                       metrics.render().encode())
@@ -338,41 +435,57 @@ class Handler(BaseHTTPRequestHandler):
         guard = contextlib.nullcontext() if state.batch_engine is not None else state.lock
         with guard:
             if stream:
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-                _count_http(self.path, 200)
+                # SSE headers are DEFERRED to the first delta: an error
+                # raised before any output (validation, load shed, drain,
+                # queue-TTL expiry) gets its real status code (400/503/408)
+                # instead of a 200 stream carrying an error event
                 completion_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+                started = [False]
+
+                def _start_stream():
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    _count_http(self.path, 200)
+                    started[0] = True
 
                 def emit(text):
+                    if not started[0]:
+                        _start_stream()
                     payload = _chunk_payload(state, completion_id, {"content": text}, None)
                     self._write_chunk(f"data: {json.dumps(payload)}\n\n".encode())
 
                 try:
                     _text, finish = run_completion(state, body, emit)
-                    self._write_chunk(
-                        ("data: " + json.dumps(
-                            _chunk_payload(state, completion_id, {}, finish))
-                         + "\n\n").encode())
-                except Exception as e:  # headers already sent: error as SSE event
+                except Exception as e:
+                    if not started[0]:  # nothing sent: honest status code
+                        self._mapped_error(e)
+                        return
+                    # mid-stream: error as SSE event, then terminate
                     self._write_chunk(
                         ("data: " + json.dumps({"error": {
                             "message": str(e), "type": "server_error"}})
                          + "\n\n").encode())
-                finally:
-                    # always terminate the chunked stream so clients don't hang
                     self._write_chunk(b"data: [DONE]\n\n")
                     self._write_chunk(b"")
+                    return
+                if not started[0]:  # zero-delta completion still streams
+                    _start_stream()
+                self._write_chunk(
+                    ("data: " + json.dumps(
+                        _chunk_payload(state, completion_id, {}, finish))
+                     + "\n\n").encode())
+                # always terminate the chunked stream so clients don't hang
+                self._write_chunk(b"data: [DONE]\n\n")
+                self._write_chunk(b"")
             else:
                 try:
                     text, finish = run_completion(state, body, lambda _t: None)
                     self._json(200, _completion_payload(state, text, finish))
-                except ValueError as e:
-                    self._error(400, str(e), "invalid_request_error")
                 except Exception as e:
-                    self._error(500, str(e), "server_error")
+                    self._mapped_error(e)
 
     def _write_chunk(self, data: bytes):
         self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
@@ -385,7 +498,8 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           device_loop_chunk: int = 0, batch_engine=None,
           speculative_k: int = 0, prefix_cache=True,
           prefix_cache_blocks: int = 0, prefix_block_tokens: int = 16,
-          prefix_cache_q80: bool = False) -> ThreadingHTTPServer:
+          prefix_cache_q80: bool = False,
+          request_deadline: float = 0.0) -> ThreadingHTTPServer:
     if batch_engine is not None and speculative_k > 0:
         # guard EVERY caller, not just the CLI: the batch scheduler has no
         # per-request verify dispatch, so the flag would be silently inert
@@ -398,11 +512,66 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
                      speculative_k=speculative_k, prefix_cache=prefix_cache,
                      prefix_cache_blocks=prefix_cache_blocks,
                      prefix_block_tokens=prefix_block_tokens,
-                     prefix_cache_q80=prefix_cache_q80)
+                     prefix_cache_q80=prefix_cache_q80,
+                     request_deadline=request_deadline)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = ThreadingHTTPServer((host, port), handler)
+    server.api_state = state  # drain controller / tests reach the state here
     print(f"🟢 dllama-api listening on {host}:{port}")
     return server
+
+
+def begin_drain(server: ThreadingHTTPServer, state: ApiState,
+                drain_timeout: float = 30.0) -> None:
+    """Graceful drain (the SIGTERM body; docs/ROBUSTNESS.md):
+
+    1. flip state.draining — `/healthz` answers 503 "draining" (the LB stops
+       routing) and new completions are refused with 503;
+    2. let in-flight AND already-queued requests finish, bounded by
+       drain_timeout (BatchEngine.close(drain=True); single-engine mode
+       waits for the generation lock);
+    3. stop accepting connections and return.
+
+    Idempotent: a second call (double SIGTERM) skips straight to shutdown.
+    """
+    already = state.draining
+    state.draining = True
+    be = state.batch_engine
+    if not already:
+        print(f"🟡 draining: letting in-flight requests finish "
+              f"(timeout {drain_timeout:.0f}s)")
+        if be is not None:
+            be.close(drain=True, timeout=drain_timeout)
+        else:
+            # single-engine mode: in-flight == the generation lock is held;
+            # handlers queued behind it observe draining and 503 immediately
+            deadline = time.monotonic() + drain_timeout
+            while time.monotonic() < deadline:
+                if state.lock.acquire(timeout=0.1):
+                    state.lock.release()
+                    break
+    server.shutdown()
+    print("🔴 drained, server stopped")
+
+
+def install_sigterm_drain(server: ThreadingHTTPServer, state: ApiState,
+                          drain_timeout: float = 30.0) -> bool:
+    """Install the SIGTERM -> begin_drain handler (main thread only; returns
+    False where signals can't be installed). The handler runs the drain on a
+    worker thread so the signal frame returns immediately — serve_forever()
+    unblocks when the drain calls server.shutdown()."""
+    import signal
+
+    def _on_term(signum, frame):
+        threading.Thread(target=begin_drain,
+                         args=(server, state, drain_timeout),
+                         name="drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # not the main thread
+        return False
+    return True
 
 
 def main(argv=None) -> None:
@@ -442,10 +611,30 @@ def main(argv=None) -> None:
                         "than f32) — capacity over bit-exactness: a cold hit "
                         "is a near-lossless dequantized seed, not an exact "
                         "replay (docs/PREFIX_CACHE.md cost model)")
+    p.add_argument("--max-queue", type=int, default=0, metavar="N",
+                   help="admission control (--batch > 1 only): refuse new "
+                        "requests with 503 + Retry-After once N are waiting "
+                        "for a slot (0 = unbounded; docs/ROBUSTNESS.md)")
+    p.add_argument("--queue-ttl", type=float, default=0.0, metavar="S",
+                   help="(--batch > 1 only) expire requests that waited more "
+                        "than S seconds for a slot: 408 timeout_error, finish "
+                        "reason 'deadline' (0 = no TTL)")
+    p.add_argument("--request-deadline", type=float, default=0.0, metavar="S",
+                   help="wall-clock deadline per request: generation past S "
+                        "seconds stops with finish reason 'deadline' (partial "
+                        "output delivered); with --batch > 1 the scheduler "
+                        "enforces it over queue + generation and expiry "
+                        "before the first token is a 408; with --batch 1 it "
+                        "bounds generation per token (0 = none)")
+    p.add_argument("--drain-timeout", type=float, default=30.0, metavar="S",
+                   help="SIGTERM graceful drain: /healthz flips to 503 "
+                        "'draining', admissions stop, in-flight requests get "
+                        "up to S seconds to finish before the server closes")
     args = p.parse_args(argv)
     from .dllama import dump_trace, install_trace
 
     install_trace(args)
+    faults.install_from_env()  # DLLAMA_FAULTS chaos config (resilience/)
     batch_engine = None
     if args.dp > 1 and args.batch <= 1:
         p.error("--dp requires --batch > 1 (data parallelism shards batched cache rows)")
@@ -478,6 +667,7 @@ def main(argv=None) -> None:
             prefix_cache_blocks=args.prefix_cache_blocks,
             prefix_block_tokens=args.prefix_cache_block_tokens,
             prefix_cache_q80=args.prefix_cache_q80,
+            max_queue=args.max_queue, queue_ttl=args.queue_ttl,
             tp=args.tp, dp=args.dp, pod=args.pod,
             cache_write=args.cache_write, moe_sharding=args.moe_sharding,
             fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
@@ -502,12 +692,21 @@ def main(argv=None) -> None:
                    prefix_cache=not args.no_prefix_cache,
                    prefix_cache_blocks=args.prefix_cache_blocks,
                    prefix_block_tokens=args.prefix_cache_block_tokens,
-                   prefix_cache_q80=args.prefix_cache_q80)
+                   prefix_cache_q80=args.prefix_cache_q80,
+                   request_deadline=args.request_deadline)
+    # SIGTERM -> graceful drain (docs/ROBUSTNESS.md): /healthz flips to
+    # draining, admissions stop, in-flight requests finish, then shutdown
+    install_sigterm_drain(server, server.api_state, args.drain_timeout)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        if batch_engine is not None:
+            # idempotent after a SIGTERM drain (close() re-entry is a no-op
+            # walk over already-freed slots); a Ctrl-C exit aborts in-flight
+            # requests with EngineClosed instead of leaking the scheduler
+            batch_engine.close()
         dump_trace(args)  # --trace: flush the span buffer on shutdown
 
 

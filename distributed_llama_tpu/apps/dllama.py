@@ -148,6 +148,40 @@ def check_kv_storage(args) -> None:
 _FT = {"f32": FloatType.F32, "f16": FloatType.F16, "q40": FloatType.Q40,
        "q80": FloatType.Q80}
 
+_GRACEFUL_STOP = None  # threading.Event set by the first SIGTERM
+
+
+def install_graceful_stop():
+    """SIGTERM during a CLI generation stops cleanly after the current token
+    (stats still print, the partial output is complete text) instead of
+    killing the process mid-dispatch; a second SIGTERM hard-stops via
+    KeyboardInterrupt. Returns the Event, or None where signal handlers
+    can't be installed (non-main thread, e.g. under a test runner)."""
+    global _GRACEFUL_STOP
+    import signal
+    import threading
+
+    ev = threading.Event()
+
+    def _on_term(signum, frame):
+        if ev.is_set():
+            raise KeyboardInterrupt
+        ev.set()
+        print("\n🟡 SIGTERM: finishing the current token, then stopping "
+              "(send again to hard-stop)", file=sys.stderr)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # not the main thread
+        return None
+    _GRACEFUL_STOP = ev
+    return ev
+
+
+def stop_requested() -> bool:
+    """True once SIGTERM asked the CLI generation loop to wind down."""
+    return _GRACEFUL_STOP is not None and _GRACEFUL_STOP.is_set()
+
 
 def install_trace(args) -> bool:
     """--trace bootstrap (shared by dllama and api_server): install the
@@ -246,6 +280,7 @@ def mode_inference(args) -> None:
         pieces.append(piece)
 
     out, stats = engine.generate_with(prompt, args.steps, sampler, on_token=on_token,
+                                      stop_check=lambda t: stop_requested(),
                                       device_loop_chunk=args.device_loop,
                          speculative_k=args.speculative)
     text = b"".join(pieces).decode("utf-8", errors="replace")
@@ -294,7 +329,7 @@ def mode_generate(args) -> None:
         prev = t
 
     engine.generate_with(prompt, args.steps, sampler, on_token=on_token,
-                         stop_check=lambda t: t == tok.eos_id,
+                         stop_check=lambda t: t == tok.eos_id or stop_requested(),
                          device_loop_chunk=args.device_loop,
                          speculative_k=args.speculative)
     print()
@@ -342,9 +377,13 @@ def mode_chat(args) -> None:
         streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit)
         engine.generate_with(prompt, engine.spec.seq_len - engine.pos - 1, sampler,
                              on_token=streamer.on_token,
-                             stop_check=streamer.stop_check,
+                             stop_check=lambda t: (streamer.stop_check(t)
+                                                   or stop_requested()),
                              device_loop_chunk=args.device_loop,
                          speculative_k=args.speculative)
+        if stop_requested():
+            print("\n(terminated)")
+            break
         if engine.pos >= engine.spec.seq_len - 1:
             print("\n(context end reached)")
             break
@@ -357,6 +396,10 @@ def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     check_kv_storage(args)
     install_trace(args)
+    from ..resilience import faults
+
+    faults.install_from_env()  # DLLAMA_FAULTS chaos config (resilience/)
+    install_graceful_stop()  # SIGTERM: stop after the current token
     try:
         {"inference": mode_inference, "generate": mode_generate,
          "chat": mode_chat}[args.mode](args)

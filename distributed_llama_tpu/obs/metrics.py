@@ -159,6 +159,10 @@ class Counter(_Metric):
 class Gauge(_Metric):
     typ = "gauge"
 
+    def _init_value(self) -> None:
+        self.value = 0.0
+        self._fn = None
+
     def set(self, v: float) -> None:
         with self._lock:
             self.value = float(v)
@@ -171,8 +175,28 @@ class Gauge(_Metric):
         with self._lock:
             self.value -= v
 
+    def set_function(self, fn) -> None:
+        """Evaluate `fn()` at scrape time instead of a stored value — the
+        prometheus_client callback-gauge idiom, for values that are a
+        *reading* of live state (e.g. seconds since the last scheduler
+        dispatch) rather than an event stream. A raising callback degrades
+        to the last stored value: a scrape must never 500 because the
+        subject died (that being exactly when the scrape matters)."""
+        self._fn = fn
+
+    def _read(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:
+                pass
+        return self.value
+
     def _samples(self):
-        return [("", "", self.value)]
+        return [("", "", self._read())]
+
+    def _snapshot_self(self):
+        return self._read()
 
 
 class Histogram(_Metric):

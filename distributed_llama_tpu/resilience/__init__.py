@@ -1,0 +1,23 @@
+"""Resilience layer: fault injection + typed failure taxonomy
+(docs/ROBUSTNESS.md).
+
+- `faults.py` — named injection points (`faults.fire`), deterministic
+  FaultSpec/FaultPlan machinery, DLLAMA_FAULTS env activation.
+- `errors.py` — typed errors the serving stack raises and the HTTP layer
+  maps to honest status codes, plus `classify()` (the scheduler's
+  blast-radius switch: transient / request / engine).
+
+Consumers: runtime/batch_engine.py (retry + isolation), runtime/engine.py,
+runtime/device_loop.py, runtime/paged_cache.py (injection points),
+apps/api_server.py (error mapping, shedding, drain), perf/fault_matrix.py
+and tests/test_resilience.py (chaos drivers).
+"""
+
+from . import faults
+from .errors import (DeadlineExceeded, EngineClosed, EngineDraining,
+                     EngineSaturated, FaultInjected, InvalidRequest,
+                     TransientDispatchError, classify)
+
+__all__ = ["faults", "DeadlineExceeded", "EngineClosed", "EngineDraining",
+           "EngineSaturated", "FaultInjected", "InvalidRequest",
+           "TransientDispatchError", "classify"]

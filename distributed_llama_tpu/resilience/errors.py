@@ -1,0 +1,92 @@
+"""Typed errors for the resilience layer (docs/ROBUSTNESS.md).
+
+The pre-PR-4 serving stack had exactly one failure shape: a bare
+`RuntimeError` that meant anything from "the queue is full" to "the scheduler
+thread crashed mid-dispatch". These types give every failure mode a name the
+HTTP layer can map to an honest status code (and tests can assert on):
+
+    EngineClosed / EngineDraining  -> 503 (server going away)
+    EngineSaturated                -> 503 + Retry-After (load shed)
+    DeadlineExceeded               -> 408 (queue TTL / generation deadline)
+    InvalidRequest                 -> 400 (caller error, not server error)
+    TransientDispatchError         -> retried by the scheduler, never surfaced
+                                      unless retries are exhausted
+
+`classify()` is the single blast-radius switch the BatchEngine scheduler
+uses: every exception escaping a dispatch is sorted into `transient`
+(retry in place), `request` (fail only the attributable request; the other
+co-batched slots keep decoding), or `engine` (fail all in-flight, survive,
+back off). Exceptions may carry an explicit `fault_scope` attribute — the
+fault-injection framework (faults.py) uses it to declare the blast radius a
+test intends.
+"""
+
+from __future__ import annotations
+
+__all__ = ["EngineClosed", "EngineDraining", "EngineSaturated",
+           "DeadlineExceeded", "InvalidRequest", "TransientDispatchError",
+           "FaultInjected", "classify"]
+
+
+class EngineClosed(RuntimeError):
+    """The engine is shut down; queued/in-flight requests were aborted."""
+
+
+class EngineDraining(EngineClosed):
+    """The engine is draining (SIGTERM): in-flight requests finish, new
+    admissions are refused. A subclass of EngineClosed so existing
+    `except EngineClosed` handlers cover both."""
+
+
+class EngineSaturated(RuntimeError):
+    """Admission refused: the submit queue is at --max-queue. Carries
+    `retry_after` (seconds, advisory) for the HTTP 503 Retry-After header."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's queue TTL or wall-clock generation deadline expired
+    before completion (finish reason "deadline")."""
+
+
+class InvalidRequest(ValueError):
+    """The request itself is malformed (prompt exceeds seq_len, bad
+    max_tokens): a 400, never a 500 or a stall."""
+
+
+class TransientDispatchError(RuntimeError):
+    """A dispatch failure expected to succeed on retry (injected transient
+    faults; preemption-shaped runtime errors registered by the caller). The
+    scheduler retries these with capped exponential backoff before treating
+    them as engine-scope."""
+
+    fault_scope = "transient"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by the fault-injection framework at a named point. `scope`
+    declares the blast radius the scheduler may assume: "request" faults are
+    attributable to one request (the injection fired before any shared state
+    changed), "engine" faults are not."""
+
+    def __init__(self, msg: str, scope: str = "request"):
+        super().__init__(msg)
+        assert scope in ("request", "engine"), scope
+        self.fault_scope = scope
+
+
+def classify(exc: BaseException) -> str:
+    """Blast radius of an exception: 'transient' | 'request' | 'engine'.
+
+    Honors an explicit `fault_scope` attribute first (set by FaultInjected /
+    TransientDispatchError), then falls back to 'engine' — the conservative
+    default: a real, unattributed dispatch failure may have left the shared
+    caches indeterminate, so it must fail every in-flight request rather
+    than silently corrupt a survivor."""
+    scope = getattr(exc, "fault_scope", None)
+    if scope in ("transient", "request", "engine"):
+        return scope
+    return "engine"

@@ -1,0 +1,208 @@
+"""Deterministic fault injection for the serving runtime (docs/ROBUSTNESS.md).
+
+Nothing in the stack *tested* the unhappy path before this module existed: a
+poisoned request, a hung dispatch, or a transient runtime error could only be
+reproduced by getting unlucky in production. This framework turns those into
+named, seedable events:
+
+- **Injection points** are `faults.fire("name", **ctx)` calls wired into the
+  runtime hot paths (engine dispatch, BatchEngine prefill/dispatch/emit/seed,
+  device-loop dispatch, paged-cache append/cold-attend, api request entry).
+  The full inventory lives in docs/ROBUSTNESS.md and perf/fault_matrix.py.
+- **FaultSpec** describes what happens at a point: raise an error (with a
+  declared blast-radius `scope`), raise a `TransientDispatchError` (the
+  scheduler retries these), or inject a latency spike. Specs select by point
+  name (fnmatch glob), optional context match (e.g. `match={"slot": 1}`),
+  per-fire probability, a skip-first-N `after`, and a max-fires `count`.
+- **Determinism**: probability draws come from one `random.Random(seed)`
+  owned by the plan, so a chaos run replays exactly under the same seed and
+  schedule.
+- **Activation**: `faults.active(...)` (context manager, tests),
+  `faults.install(...)` (process-wide), or the `DLLAMA_FAULTS` env var parsed
+  by `install_from_env()` (wired into the dllama / api_server entry points):
+
+      DLLAMA_FAULTS="point:kind[:prob[:count[:delay_ms]]][,spec2,...]"
+      DLLAMA_FAULT_SEED=7
+
+  e.g. `DLLAMA_FAULTS="batch.dispatch:transient:0.01"` injects a 1% transient
+  dispatch-failure rate into a live server.
+
+The disabled hot path is one module-global None check (`fire()` returns
+immediately) — the same discipline as obs/trace.py's no-op tracer, so the
+points can stay wired in production builds.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from ..obs import metrics
+from .errors import FaultInjected, TransientDispatchError
+
+__all__ = ["KINDS", "FaultSpec", "FaultPlan", "fire", "install", "uninstall",
+           "active", "current", "parse_faults", "install_from_env"]
+
+KINDS = ("error", "transient", "latency")
+
+_INJECTED = metrics.counter(
+    "faults_injected_total",
+    "Faults fired by the injection framework (docs/ROBUSTNESS.md)",
+    labelnames=("point", "kind"))
+
+
+@dataclass
+class FaultSpec:
+    """One injection rule. `point` is an exact name or fnmatch glob
+    ("batch.*"); `kind` is error | transient | latency; `scope` declares the
+    blast radius an *error* fault promises (the injection fires before the
+    guarded operation touches shared state, so "request" is sound for the
+    per-request points); `match` filters on fire-site context kwargs."""
+
+    point: str
+    kind: str = "error"
+    prob: float = 1.0
+    count: int | None = None   # max fires (None = unlimited)
+    after: int = 0             # skip the first N matching hits
+    delay_ms: float = 25.0     # latency kind: injected stall
+    scope: str = "request"     # error kind: request | engine
+    match: dict = field(default_factory=dict)
+    seen: int = 0              # matching hits observed (runtime state)
+    fired: int = 0             # faults actually injected (runtime state)
+
+    def __post_init__(self):
+        assert self.kind in KINDS, f"unknown fault kind {self.kind!r}"
+        assert self.scope in ("request", "engine"), self.scope
+        assert 0.0 <= self.prob <= 1.0, self.prob
+
+
+class FaultPlan:
+    """An installed set of FaultSpecs sharing one seeded RNG."""
+
+    def __init__(self, specs, seed: int = 0):
+        self.specs = list(specs)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def fired(self) -> int:
+        return sum(s.fired for s in self.specs)
+
+    def fire(self, point: str, **ctx) -> None:
+        for spec in self.specs:
+            if not fnmatch.fnmatchcase(point, spec.point):
+                continue
+            if any(ctx.get(k) != v for k, v in spec.match.items()):
+                continue
+            with self._lock:
+                spec.seen += 1
+                if spec.seen <= spec.after:
+                    continue
+                if spec.count is not None and spec.fired >= spec.count:
+                    continue
+                if spec.prob < 1.0 and self._rng.random() >= spec.prob:
+                    continue
+                spec.fired += 1
+            _INJECTED.labels(point=point, kind=spec.kind).inc()
+            if spec.kind == "latency":
+                time.sleep(spec.delay_ms / 1000.0)
+                continue  # a latency spike doesn't shadow later error specs
+            if spec.kind == "transient":
+                raise TransientDispatchError(
+                    f"injected transient fault at {point}")
+            raise FaultInjected(f"injected fault at {point}",
+                                scope=spec.scope)
+
+
+_PLAN: FaultPlan | None = None
+
+
+def fire(point: str, **ctx) -> None:
+    """Injection-point hook: no-op (one None check) unless a plan is
+    installed. Context kwargs are matched against each spec's `match`."""
+    plan = _PLAN
+    if plan is not None:
+        plan.fire(point, **ctx)
+
+
+def install(specs, seed: int = 0) -> FaultPlan:
+    """Install a plan process-wide (replaces any previous plan). Accepts a
+    ready FaultPlan or an iterable of FaultSpecs."""
+    global _PLAN
+    plan = specs if isinstance(specs, FaultPlan) else FaultPlan(specs,
+                                                                seed=seed)
+    _PLAN = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def current() -> FaultPlan | None:
+    return _PLAN
+
+
+@contextmanager
+def active(*specs, seed: int = 0):
+    """Scoped activation for tests: installs the specs, uninstalls on exit
+    (only if the plan is still this one — a nested install wins)."""
+    plan = install(list(specs), seed=seed)
+    try:
+        yield plan
+    finally:
+        if _PLAN is plan:
+            uninstall()
+
+
+def parse_faults(text: str) -> list[FaultSpec]:
+    """Parse the DLLAMA_FAULTS grammar:
+
+        spec[,spec...]   spec = point:kind[:prob[:count[:delay_ms]]]
+
+    `count` may be empty or "inf" for unlimited. Raises ValueError with the
+    offending spec on malformed input (a typo'd chaos config must fail loud,
+    not silently inject nothing)."""
+    specs = []
+    for raw in text.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) < 2 or len(parts) > 5:
+            raise ValueError(f"bad fault spec {raw!r} "
+                             "(point:kind[:prob[:count[:delay_ms]]])")
+        point, kind = parts[0], parts[1]
+        if kind not in KINDS:
+            raise ValueError(f"bad fault kind {kind!r} in {raw!r} "
+                             f"(one of {KINDS})")
+        try:
+            prob = float(parts[2]) if len(parts) > 2 and parts[2] else 1.0
+            count = (None if len(parts) <= 3 or parts[3] in ("", "inf")
+                     else int(parts[3]))
+            delay = float(parts[4]) if len(parts) > 4 and parts[4] else 25.0
+        except ValueError:
+            raise ValueError(f"bad numeric field in fault spec {raw!r}")
+        specs.append(FaultSpec(point=point, kind=kind, prob=prob, count=count,
+                               delay_ms=delay))
+    return specs
+
+
+def install_from_env(environ=None) -> FaultPlan | None:
+    """Install a plan from DLLAMA_FAULTS / DLLAMA_FAULT_SEED; None when the
+    env is unset. Idempotent enough for multiple entry-point calls: an
+    already-installed plan is kept (explicit install() wins over env)."""
+    env = os.environ if environ is None else environ
+    text = env.get("DLLAMA_FAULTS")
+    if not text:
+        return None
+    if _PLAN is not None:
+        return _PLAN
+    seed = int(env.get("DLLAMA_FAULT_SEED", "0"))
+    return install(parse_faults(text), seed=seed)

@@ -57,6 +57,9 @@ import numpy as np
 
 from ..models.spec import ModelSpec
 from ..obs import metrics, trace
+from ..resilience import faults
+from ..resilience.errors import (DeadlineExceeded, EngineClosed,
+                                 EngineDraining, EngineSaturated, classify)
 from .engine import PREFILL_CHUNKS, GenerationStats
 
 __all__ = ["BatchEngine", "BatchRequest"]
@@ -102,6 +105,33 @@ _PREFIX_SEEDED = metrics.counter(
     "batch_prefix_seeded_tokens_total",
     "Cache rows copied from the prefix-cache pool at admission "
     "(prompt tokens whose prefill was skipped beyond the same-slot rewind)")
+# Resilience telemetry (docs/ROBUSTNESS.md): every unhappy-path decision the
+# scheduler makes — error blast radius, transient retries, shed admissions,
+# expired deadlines — is a counter, and scheduler liveness is a gauge pair
+# (alive flag + seconds since the last successful dispatch) so a hung or dead
+# scheduler is visible on /metrics before clients notice.
+_ENGINE_ERRORS = metrics.counter(
+    "engine_errors_total",
+    "Dispatch/scheduler errors by blast radius "
+    "(transient=retried, request=failed one request, engine=failed all)",
+    labelnames=("kind",))
+_RETRIES = metrics.counter(
+    "engine_retries_total",
+    "Transient dispatch failures retried with backoff")
+_SHED = metrics.counter(
+    "engine_shed_requests_total",
+    "Admissions refused because the queue was at --max-queue")
+_DEADLINE_EXPIRED = metrics.counter(
+    "engine_deadline_expired_total",
+    "Requests expired by queue TTL or generation deadline, by where",
+    labelnames=("where",))
+_SCHED_ALIVE = metrics.gauge(
+    "batch_scheduler_alive",
+    "1 while the BatchEngine scheduler thread is running (0 = dead/idle)")
+_DISPATCH_AGE = metrics.gauge(
+    "batch_dispatch_age_seconds",
+    "Dispatch watchdog: seconds since the scheduler last completed a device "
+    "dispatch, 0 while idle (read at scrape time)")
 
 
 @dataclass
@@ -120,6 +150,13 @@ class BatchRequest:
 
     cancelled: bool = False
     submit_t: float = 0.0  # perf_counter at submit(), feeds batch_queue_wait
+    # absolute perf_counter deadline for the WHOLE request (queue + decode);
+    # 0 = none. The scheduler enforces it once per loop pass (finish reason
+    # "deadline"), so granularity is one dispatch (~K token-times).
+    deadline_t: float = 0.0
+    # absolute perf_counter bound on QUEUE time only (expired before a slot
+    # was assigned -> finish "deadline" without ever prefilling); 0 = none
+    queue_ttl_t: float = 0.0
 
     def cancel(self) -> None:
         """Ask the scheduler to stop decoding this request (client went away)."""
@@ -127,7 +164,13 @@ class BatchRequest:
 
     def wait(self, timeout=None) -> list[int]:
         if not self.done.wait(timeout):
-            raise TimeoutError(f"generation not finished within {timeout}s")
+            # auto-cancel: a timed-out waiter previously walked away while
+            # the request kept decoding to max_tokens with its slot (and any
+            # prefix-cache lease) pinned — the scheduler reaps a cancelled
+            # request on its next pass through the existing _finish path
+            self.cancel()
+            raise TimeoutError(
+                f"generation not finished within {timeout}s (auto-cancelled)")
         if self.error is not None:
             raise self.error
         return self.out
@@ -148,6 +191,12 @@ class _Slot:
         # prefix-cache lease pinning the blocks this slot was seeded from
         # (released at _finish; shrunk when history is truncated)
         self.lease = None
+        self.admit_t = 0.0  # monotonic admission time (dispatch watchdog)
+        # last_token is sampled/delivered but its KV not yet written: a
+        # dispatch that fails AFTER _advance_row consumed next_token must not
+        # re-advance (and spuriously finish) the row on retry — _advance_row
+        # is a no-op while armed; the successful ingesting dispatch clears it
+        self.armed = False
         # set BEFORE a super-step's delivery loop when the scan will park
         # this row clamped at seq_len-1 (destroying that history row): a
         # mid-loop _finish must harvest the TRUNCATED history, not the
@@ -166,7 +215,9 @@ class BatchEngine:
     def __init__(self, spec: ModelSpec, params, tokenizer=None, *, slots: int = 2,
                  superstep: int = 8, prefix_cache=True,
                  prefix_cache_blocks: int = 0, prefix_block_tokens: int = 16,
-                 prefix_cache_q80: bool = False, **engine_kw):
+                 prefix_cache_q80: bool = False, max_queue: int = 0,
+                 queue_ttl: float = 0.0, max_retries: int = 3,
+                 retry_backoff: float = 0.05, **engine_kw):
         from .engine import Engine
 
         assert slots >= 1
@@ -203,8 +254,22 @@ class BatchEngine:
         # so enqueue latency is bounded by lock handoff, not a poll interval
         self._cond = threading.Condition()
         self._shutdown = False
+        self._draining = False  # drain mode: serve in-flight, refuse new
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # Admission control (docs/ROBUSTNESS.md): max_queue bounds the number
+        # of requests WAITING for a slot (0 = unbounded, the pre-PR-4
+        # behavior); queue_ttl bounds how long a request may wait queued;
+        # both are plain attributes so a server can tune them live.
+        self.max_queue = max_queue
+        self.queue_ttl = queue_ttl
+        # transient-dispatch retry policy: capped exponential backoff
+        # starting at retry_backoff seconds, max_retries attempts beyond the
+        # first before the error escalates to engine scope
+        self.max_retries = max_retries
+        self.retry_backoff = retry_backoff
+        self._last_dispatch_t: float | None = None  # monotonic, watchdog
+        _DISPATCH_AGE.set_function(self._dispatch_age)
         # Cross-request prefix cache (cache/): pass False to disable, True for
         # defaults, or a ready PrefixCache instance to share one across
         # engines. Paged engines are excluded — their ring layout has no
@@ -240,13 +305,36 @@ class BatchEngine:
     # ------------------------------------------------------------------
 
     def submit(self, prompt: list[int], max_tokens: int, sampler,
-               on_token=None, stop_check=None) -> BatchRequest:
+               on_token=None, stop_check=None, *, deadline: float | None = None,
+               ttl: float | None = None) -> BatchRequest:
+        """Enqueue a request. `deadline` (seconds) bounds the WHOLE request
+        (queue + generation; finish reason "deadline", partial output kept);
+        `ttl` bounds queue wait only (overrides the engine's queue_ttl).
+        Raises EngineDraining/EngineClosed during shutdown and
+        EngineSaturated when the wait queue is at max_queue."""
+        if self._draining and not self._shutdown:
+            raise EngineDraining(
+                "BatchEngine is draining (serving in-flight requests only)")
         if self._shutdown:
-            raise RuntimeError("BatchEngine is closed")
+            raise EngineClosed("BatchEngine is closed")
+        faults.fire("batch.submit")
+        if self.max_queue:
+            with self._plock:
+                queued = len(self._pending) + self._queue.qsize()
+            if queued >= self.max_queue:
+                _SHED.inc()
+                raise EngineSaturated(
+                    f"queue depth {queued} at max_queue={self.max_queue}",
+                    retry_after=max(self.queue_ttl, 1.0))
         req = BatchRequest(list(prompt), max_tokens, sampler, on_token, stop_check)
         if not req.prompt:
             req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
         req.submit_t = time.perf_counter()
+        if deadline is not None and deadline > 0:
+            req.deadline_t = req.submit_t + deadline
+        eff_ttl = self.queue_ttl if ttl is None else ttl
+        if eff_ttl and eff_ttl > 0:
+            req.queue_ttl_t = req.submit_t + eff_ttl
         self._ensure_thread()
         self._queue.put(req)
         with self._cond:
@@ -260,16 +348,63 @@ class BatchEngine:
         out = req.wait()
         return out, req.stats
 
-    def close(self) -> None:
+    @property
+    def draining(self) -> bool:
+        return self._draining and not self._shutdown
+
+    def scheduler_alive(self) -> bool:
+        """True while the scheduler thread can serve (running, or not yet
+        lazily started). False only after the thread died — the /healthz
+        liveness signal."""
+        t = self._thread
+        return t is None or t.is_alive()
+
+    def _dispatch_age(self) -> float:
+        """Watchdog reading: 0 while nothing is in flight (an idle scheduler
+        is not a hung one); otherwise seconds since the scheduler last made
+        progress — the later of the last completed dispatch and the oldest
+        live admission, so a hang in the very FIRST dispatch (or the first
+        after an idle period) grows from the moment work arrived instead of
+        reading 0 / a stale pre-idle timestamp forever."""
+        busy = [s.admit_t for s in self._slots if s.req is not None]
+        if not busy:
+            return 0.0
+        ref = min(busy)
+        if self._last_dispatch_t is not None and self._last_dispatch_t > ref:
+            ref = self._last_dispatch_t
+        return max(time.monotonic() - ref, 0.0)
+
+    def close(self, drain: bool = False, timeout: float | None = None) -> None:
+        """Stop the engine. `drain=True` (the SIGTERM path): refuse new
+        admissions (submit raises EngineDraining) but let every in-flight AND
+        already-queued request finish, bounded by `timeout` seconds (None =
+        30); then close. `drain=False`: abort everything immediately —
+        waiters get EngineClosed."""
+        if drain and not self._shutdown:
+            self._draining = True
+            deadline = time.monotonic() + (30.0 if timeout is None else timeout)
+            while time.monotonic() < deadline:
+                with self._plock:
+                    busy = (any(s.req is not None for s in self._slots)
+                            or bool(self._pending))
+                if not busy and self._queue.empty():
+                    break
+                time.sleep(0.01)
         self._shutdown = True
         with self._cond:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        # detach the watchdog callback IF it is still ours (a later engine
+        # may have claimed the gauge): a bound method left on the
+        # module-global gauge would pin this engine's params + KV caches
+        # past close() for the process lifetime
+        if _DISPATCH_AGE._fn == self._dispatch_age:
+            _DISPATCH_AGE.set_function(None)
         # unblock every waiter: in-flight slots and still-queued requests. The
         # scheduler may still be alive after the join timeout (long device step), so
         # snapshot each slot's request and tolerate it finishing concurrently.
-        err = RuntimeError("BatchEngine closed")
+        err = EngineClosed("BatchEngine closed")
         with self._plock:
             for s in self._slots:
                 if self.prefix_cache is not None and s.lease is not None:
@@ -323,6 +458,7 @@ class BatchEngine:
         reuse = common(best)
         if self.prefix_cache is not None:
             reuse = self._seed_from_cache(best, req, reuse)
+        best.admit_t = time.monotonic()  # before .req: the watchdog keys on req
         best.req = req
         best.pos = reuse
         best.history = list(req.prompt[:reuse])
@@ -330,6 +466,7 @@ class BatchEngine:
         best.last_logits = None
         best.next_token = None
         best.clamp_pos = None
+        best.armed = False
         req.stats.prompt_tokens = len(req.prompt)
         if req.submit_t:
             _QUEUE_WAIT.observe(time.perf_counter() - req.submit_t)
@@ -343,12 +480,22 @@ class BatchEngine:
         The acquired lease stays on the slot until _finish (eviction must
         respect in-flight slots); seeding failures fall back to plain
         prefill — the cache is an optimization, never a correctness gate."""
-        lease = self.prefix_cache.lookup(req.prompt,
-                                         cap=self.spec.seq_len - 1)
-        if lease is None:
-            return reuse
-        if lease.tokens <= reuse:
-            self.prefix_cache.mark_unused(lease)
+        try:
+            faults.fire("batch.cache_seed", slot=slot.index)
+            lease = self.prefix_cache.lookup(req.prompt,
+                                             cap=self.spec.seq_len - 1)
+            if lease is None:
+                return reuse
+            if lease.tokens <= reuse:
+                self.prefix_cache.mark_unused(lease)
+                return reuse
+        except Exception as e:
+            # a raising radix lookup (or injected seed fault) must cost only
+            # the cache win — NOT escape into the scheduler loop, where it
+            # would fail every in-flight request and leave this one queued
+            from ..cache import warn_degraded
+
+            warn_degraded("lookup", e)
             return reuse
         eng = self._eng
         n = lease.tokens
@@ -373,16 +520,47 @@ class BatchEngine:
         _PREFIX_SEEDED.inc(n - reuse)
         return n
 
-    def _step(self, tokens_rows: list[list[int]], starts: list[int], t: int):
+    def _dispatched(self, kind: str, call):
+        """Run one device dispatch with transient-fault retry: classify()
+        'transient' errors (injected TransientDispatchError, or any exception
+        carrying fault_scope='transient') are retried up to max_retries times
+        with capped exponential backoff; anything else propagates unchanged.
+        Retry is sound here because a transient failure by definition raised
+        before the dispatch consumed its inputs (the injection points fire
+        before the device call; a real mid-execution failure classifies
+        'engine' and is never retried against possibly-donated buffers)."""
+        delay = self.retry_backoff
+        attempt = 0
+        while True:
+            try:
+                faults.fire("batch.dispatch", kind=kind, attempt=attempt)
+                out = call()
+                self._last_dispatch_t = time.monotonic()
+                return out
+            except Exception as e:
+                if classify(e) != "transient" or attempt >= self.max_retries:
+                    raise
+                _ENGINE_ERRORS.labels(kind="transient").inc()
+                _RETRIES.inc()
+                attempt += 1
+                time.sleep(min(delay, 1.0))
+                delay *= 2
+
+    def _step(self, tokens_rows: list[list[int]], starts: list[int], t: int,
+              kind: str = "step"):
         """Run one batched (B, t) step; returns logits (B, t, vocab) np.ndarray."""
         eng = self._eng
         window = eng._window_for(max(s + t for s in starts))
         step = eng._step_for(window)
         toks = jnp.asarray(np.asarray(tokens_rows, dtype=np.int32))
         start_pos = jnp.asarray(np.asarray(starts, dtype=np.int32))
-        logits, eng.k_cache, eng.v_cache = step(
-            eng.params, eng.rope, toks, eng.k_cache, eng.v_cache, start_pos)
-        return np.asarray(logits)
+
+        def call():
+            logits, eng.k_cache, eng.v_cache = step(
+                eng.params, eng.rope, toks, eng.k_cache, eng.v_cache, start_pos)
+            return np.asarray(logits)
+
+        return self._dispatched(kind, call)
 
     def _finish(self, slot: _Slot, finish: str) -> None:
         req = slot.req
@@ -461,59 +639,159 @@ class BatchEngine:
             starts.append(p)
         return starts
 
-    def _loop(self) -> None:
-        while not self._shutdown:
-            # admit queued requests onto free slots (FIFO: scheduler-local overflow
-            # first, then the cross-thread queue)
-            with self._plock:
-                while True:
-                    try:
-                        self._pending.append(self._queue.get_nowait())
-                    except queue.Empty:
-                        break
-                while self._pending:
-                    if self._pending[0].cancelled:
-                        req = self._pending.pop(0)
-                        req.finish = "cancelled"
-                        _REQUESTS.labels(finish="cancelled").inc()
-                        req.done.set()
-                        continue
-                    if self._assign(self._pending[0]) is None:
-                        break  # no free slot: serve current load first
-                    self._pending.pop(0)
-                _QUEUE_DEPTH.set(len(self._pending) + self._queue.qsize())
+    def _admit(self) -> None:
+        """Drain the cross-thread queue into the scheduler-local overflow
+        list, reap cancelled/expired queued requests, and assign FIFO onto
+        free slots."""
+        now = time.perf_counter()
+        with self._plock:
+            while True:
+                try:
+                    self._pending.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            # queue-TTL / deadline expiry applies to EVERY queued request,
+            # not just the head — under sustained occupancy the head may
+            # never admit, and requests behind it must still time out
+            kept = []
+            for req in self._pending:
+                expired_by = ("queue_ttl" if req.queue_ttl_t
+                              and now >= req.queue_ttl_t
+                              else "deadline" if req.deadline_t
+                              and now >= req.deadline_t else None)
+                if expired_by is None:
+                    kept.append(req)
+                    continue
+                req.finish = "deadline"
+                req.error = DeadlineExceeded(
+                    f"request expired in queue ({expired_by})")
+                _DEADLINE_EXPIRED.labels(where="queue").inc()
+                _REQUESTS.labels(finish="deadline").inc()
+                req.done.set()
+            self._pending[:] = kept
+            while self._pending:
+                if self._pending[0].cancelled:
+                    req = self._pending.pop(0)
+                    req.finish = "cancelled"
+                    _REQUESTS.labels(finish="cancelled").inc()
+                    req.done.set()
+                    continue
+                try:
+                    assigned = self._assign(self._pending[0])
+                except Exception as e:
+                    # an admission failure is attributable to the request
+                    # being admitted: fail IT and dequeue — leaving it at
+                    # the head would re-raise every pass (hanging its waiter
+                    # forever) while _fail_all killed innocent neighbors
+                    req = self._pending.pop(0)
+                    _ENGINE_ERRORS.labels(kind="request").inc()
+                    req.error = e
+                    req.finish = "error"
+                    _REQUESTS.labels(finish="error").inc()
+                    req.done.set()
+                    continue
+                if assigned is None:
+                    break  # no free slot: serve current load first
+                self._pending.pop(0)
+            _QUEUE_DEPTH.set(len(self._pending) + self._queue.qsize())
 
-            for sl in self._slots:  # a cancelled request frees its slot immediately,
-                if sl.req is not None and sl.req.cancelled:  # even mid-prefill
-                    self._finish(sl, "cancelled")
-            prefill = [s for s in self._slots if s.req and s.pending]
-            active = [s for s in self._slots if s.req and not s.pending]
-            _SLOTS_OCCUPIED.set(sum(1 for s in self._slots if s.req is not None))
-            try:
-                if prefill:
+    def _reap_slots(self) -> None:
+        """Free slots whose request was cancelled or whose wall-clock
+        deadline expired (finish "deadline": partial output is kept; the
+        waiter gets DeadlineExceeded only when nothing was generated)."""
+        now = time.perf_counter()
+        for sl in self._slots:
+            req = sl.req
+            if req is None:
+                continue
+            if req.cancelled:  # frees the slot immediately, even mid-prefill
+                self._finish(sl, "cancelled")
+            elif req.deadline_t and now >= req.deadline_t:
+                if not req.out:
+                    req.error = DeadlineExceeded(
+                        "generation deadline expired before the first token")
+                _DEADLINE_EXPIRED.labels(where="decode").inc()
+                self._finish(sl, "deadline")
+
+    def _fail_request(self, slot: _Slot, e: Exception) -> None:
+        """Blast-radius 'request': fail ONLY this slot's request; the other
+        co-batched slots keep decoding."""
+        _ENGINE_ERRORS.labels(kind="request").inc()
+        slot.req.error = e
+        self._finish(slot, "error")
+
+    def _fail_all(self, e: Exception) -> None:
+        """Blast-radius 'engine': the shared dispatch failed unattributably
+        (caches possibly indeterminate) — fail every in-flight request. The
+        scheduler thread itself SURVIVES and keeps serving new admissions."""
+        _ENGINE_ERRORS.labels(kind="engine").inc()
+        for s in self._slots:
+            if s.req is not None:
+                s.req.error = e
+                self._finish(s, "error")
+
+    def _loop(self) -> None:
+        _SCHED_ALIVE.set(1)
+        try:
+            while not self._shutdown:
+                try:
+                    self._loop_once()
+                except Exception as e:
+                    # _loop_once guards the dispatch phase itself; this outer
+                    # net covers the admission/reap phase too (prefix-cache
+                    # lookup at _assign, lease release at a deadline _finish)
+                    # so NO exception can kill the scheduler thread — the
+                    # invariant perf/fault_matrix.py asserts
+                    try:
+                        self._fail_all(e)
+                    except Exception:
+                        pass  # even a failing abort must not stop the loop
+                    with self._cond:
+                        if not self._shutdown:
+                            self._cond.wait(timeout=0.05)
+        finally:
+            _SCHED_ALIVE.set(0)
+
+    def _loop_once(self) -> None:
+        self._admit()
+        self._reap_slots()
+        prefill = [s for s in self._slots if s.req and s.pending]
+        active = [s for s in self._slots if s.req and not s.pending]
+        _SLOTS_OCCUPIED.set(sum(1 for s in self._slots if s.req is not None))
+        try:
+            if prefill:
+                victim = prefill[0]
+                try:
                     # mixed step: active decode rows ride the prefill dispatch
                     # at T=1 instead of stalling behind it
-                    self._prefill_step(prefill[0], riders=active)
-                elif active:
-                    self._decode_step(active)
-                else:
-                    # idle: sleep on the condition until submit()/close()
-                    # notifies. The timeout is only a safety net (e.g. a
-                    # queued request cancelled while idle has no notifier);
-                    # enqueue latency is set by the notify, not this number.
-                    with self._cond:
-                        if self._queue.empty() and not self._shutdown:
-                            self._cond.wait(timeout=0.5)
-            except Exception as e:  # propagate to every in-flight request
-                for s in self._slots:
-                    if s.req is not None:
-                        s.req.error = e
-                        self._finish(s, "error")
-                # brief condition-based backoff so a persistently failing step
-                # cannot spin the scheduler hot (a notify still wakes it early)
+                    self._prefill_step(victim, riders=active)
+                except Exception as e:
+                    # a request-scope fault during a prefill dispatch is
+                    # attributable to the prefilling request (it fired before
+                    # shared state changed): kill ONLY it. The riders remain
+                    # consistent — their armed token re-dispatches next pass.
+                    if classify(e) == "request" and victim.req is not None:
+                        self._fail_request(victim, e)
+                    else:
+                        raise
+            elif active:
+                self._decode_step(active)
+            else:
+                # idle: sleep on the condition until submit()/close()
+                # notifies. The timeout is only a safety net (e.g. a
+                # queued request cancelled while idle has no notifier);
+                # enqueue latency is set by the notify, not this number.
+                # 0.1 s also bounds queue-TTL/deadline detection while idle.
                 with self._cond:
-                    if not self._shutdown:
-                        self._cond.wait(timeout=0.05)
+                    if self._queue.empty() and not self._shutdown:
+                        self._cond.wait(timeout=0.1)
+        except Exception as e:  # unattributable: fail all, survive, back off
+            self._fail_all(e)
+            # brief condition-based backoff so a persistently failing step
+            # cannot spin the scheduler hot (a notify still wakes it early)
+            with self._cond:
+                if not self._shutdown:
+                    self._cond.wait(timeout=0.05)
 
     def _emit(self, slot: _Slot, token: int) -> bool:
         """Deliver one sampled token to the request (output list, stats,
@@ -521,6 +799,10 @@ class BatchEngine:
         when the request finished (slot released). slot.pos must already count
         the ingestion of this token's input."""
         req = slot.req
+        # per-request delivery fault point: fires inside the same try blocks
+        # that guard a broken sampler/on_token callback, so an injected error
+        # here kills exactly one co-batched request (tests/test_resilience.py)
+        faults.fire("batch.emit", slot=slot.index, n_out=len(req.out))
         req.out.append(token)
         req.stats.generated_tokens += 1
         _DECODE_TOKENS.inc()
@@ -543,9 +825,12 @@ class BatchEngine:
         if req.cancelled:
             self._finish(slot, "cancelled")
             return False
+        if slot.armed:  # last_token already holds the next un-ingested token
+            return True  # (the previous dispatch failed before writing it)
         if slot.next_token is not None:  # sampled on device, already delivered
             slot.last_token = slot.next_token
             slot.next_token = None
+            slot.armed = True
             return True
         if slot.last_logits is None:  # context end hit during prefill
             self._finish(slot, "length")
@@ -559,6 +844,7 @@ class BatchEngine:
         except Exception as e:
             # a broken callback (e.g. client disconnect mid-stream) fails ONLY
             # this request; the other slots keep decoding
+            _ENGINE_ERRORS.labels(kind="request").inc()
             req.error = e
             self._finish(slot, "error")
             return False
@@ -566,9 +852,14 @@ class BatchEngine:
             return False
         slot.last_token = token
         slot.last_logits = None
+        slot.armed = True
         return True
 
     def _prefill_step(self, slot: _Slot, riders: list[_Slot] = ()) -> None:
+        # request-scope injection point: fires BEFORE the rider advance and
+        # the device dispatch, so an injected error is attributable to the
+        # prefilling request alone (_loop_once fails only it)
+        faults.fire("batch.prefill", slot=slot.index, pending=len(slot.pending))
         t0 = time.perf_counter()
         s = self.spec.seq_len
         room = s - slot.pos
@@ -603,7 +894,8 @@ class BatchEngine:
             rows[r.index] = [r.last_token] + [0] * (t - 1)
         with trace.span("batch.mixed_step" if riders else "batch.prefill",
                         {"chunk": t, "riders": len(riders)}):
-            logits = self._step(rows, starts, t)
+            logits = self._step(rows, starts, t,
+                                kind="mixed" if riders else "prefill")
         if riders:
             self.mixed_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
@@ -624,6 +916,7 @@ class BatchEngine:
             r.last_logits = logits[r.index, 0]
             r.history.append(r.last_token)
             r.pos += 1
+            r.armed = False  # the dispatch ingested last_token's KV
             r.req.stats.token_ms.append(dt_ms)
             r.req.stats.infer_ms.append(dt_ms)
             r.req.stats.dispatch_ms.append(dt_ms)
@@ -659,7 +952,7 @@ class BatchEngine:
             starts[slot.index] = slot.pos
             rows[slot.index] = [slot.last_token]
         with trace.span("batch.single_step", {"rows": len(active)}):
-            logits = self._step(rows, starts, 1)
+            logits = self._step(rows, starts, 1, kind="single_step")
         self.decode_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
         _DISP_SINGLE.observe(dt_ms / 1000.0)
@@ -668,6 +961,7 @@ class BatchEngine:
             slot.last_logits = logits[slot.index, -1]
             slot.history.append(slot.last_token)
             slot.pos += 1
+            slot.armed = False  # the dispatch ingested last_token's KV
             slot.req.stats.token_ms.append(dt_ms)
             slot.req.stats.infer_ms.append(dt_ms)
             slot.req.stats.dispatch_ms.append(dt_ms)
@@ -724,11 +1018,13 @@ class BatchEngine:
         loop = self._batched_loop(k, mode, window)
         with trace.span("batch.super_step", {"k": k, "rows": len(active),
                                              "tokens": sum(budget)}):
-            toks, rng_out, eng.k_cache, eng.v_cache = loop(
-                eng.params, eng.rope, tokens, eng.k_cache, eng.v_cache, starts,
-                rng, temps, topps, budget)
-            toks = np.asarray(toks)  # (k, B)
-            rng_out = np.asarray(rng_out)
+            def call():
+                toks, rng_out, eng.k_cache, eng.v_cache = loop(
+                    eng.params, eng.rope, tokens, eng.k_cache, eng.v_cache,
+                    starts, rng, temps, topps, budget)
+                return np.asarray(toks), np.asarray(rng_out)
+
+            toks, rng_out = self._dispatched("super_step", call)  # (k, B)
         self.decode_steps += 1
         self.super_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
@@ -755,6 +1051,7 @@ class BatchEngine:
             per_tok = dt_ms / b
             req.stats.dispatch_ms.append(dt_ms)
             x = slot.last_token  # ingested input of the block's first step
+            slot.armed = False  # the scan ingested last_token's KV
             alive = True
             delivered = 0  # block tokens actually handed to the request
             try:
@@ -773,6 +1070,10 @@ class BatchEngine:
                         break
                     x = tok
             except Exception as e:
+                # broken sampler/on_token/stop_check (or an injected
+                # batch.emit fault): this request alone dies; the other rows'
+                # blocks deliver normally (blast-radius isolation)
+                _ENGINE_ERRORS.labels(kind="request").inc()
                 req.error = e
                 self._finish(slot, "error")
                 alive = False

@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 from ..models.forward import forward
 from ..models.spec import ModelSpec
 from ..ops.rope import RopeTables
+from ..resilience import faults
 from ..parallel.mesh import AXIS_SP, AXIS_TP
 from ..parallel.sharding import kv_cache_pspec_for_mesh, param_pspecs
 from ..parallel.tp import _expand_pspec_tree
@@ -218,6 +219,7 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
 
     def run(p, rope: RopeTables, token, kc, vc, start_pos, key, temperature=0.0,
             topp=0.9):
+        faults.fire("device_loop.dispatch", n_steps=n_steps)
         return jitted(p, rope.cos, rope.sin, jnp.asarray(token, jnp.int32), kc, vc,
                       jnp.int32(start_pos), key, jnp.float32(temperature),
                       jnp.float32(topp))
@@ -327,6 +329,7 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
 
     def run(p, rope: RopeTables, tokens, kc, vc, start_pos, rng, temperature,
             topp, budget):
+        faults.fire("device_loop.batched_dispatch", n_steps=n_steps)
         rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
         toks, sh, sl, kc, vc = jitted(
             p, rope.cos, rope.sin, jnp.asarray(tokens, jnp.int32), kc, vc,

@@ -27,6 +27,7 @@ import numpy as np
 from ..models.params import Params, decode_stream_bytes, prepare_for_pallas
 from ..models.spec import ModelSpec
 from ..obs import metrics, trace
+from ..resilience import faults
 from ..ops.rope import RopeTables
 from ..parallel.mesh import AXIS_TP, make_mesh
 from ..parallel.tp import make_sharded_forward, shard_params
@@ -457,6 +458,7 @@ class Engine:
             return self._infer_traced(tokens, t)
 
     def _infer_traced(self, tokens: np.ndarray, t: int) -> np.ndarray:
+        faults.fire("engine.dispatch", t=t, pos=self.pos)
         t0 = time.perf_counter()
         if self.paged:
             # warm phase (pos + T within the ring) takes the callback-free

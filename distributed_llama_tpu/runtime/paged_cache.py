@@ -40,6 +40,7 @@ from ..models.forward import forward, init_kv_cache
 from ..models.spec import ModelSpec
 from ..obs import metrics, trace
 from ..ops.rope import RopeTables
+from ..resilience import faults
 
 _RESIDENT = metrics.gauge(
     "paged_resident_positions", "HBM hot-ring slots (--kv-cache-resident)")
@@ -120,6 +121,7 @@ class HostKVStore:
     def append(self, k_rows: np.ndarray, v_rows: np.ndarray, pos: int) -> None:
         """Write the step's new rows (L, B, hk, T, hs) at positions
         [pos, pos+T)."""
+        faults.fire("paged.append", pos=pos)
         t = k_rows.shape[3]
         self.k[:, :, :, pos:pos + t] = k_rows
         self.v[:, :, :, pos:pos + t] = v_rows
@@ -135,6 +137,7 @@ class HostKVStore:
         lse (B, T, hq) f32); an empty cold segment returns lse -inf (zero
         weight under the merge). All cold positions precede every query
         position, so no causal mask is needed."""
+        faults.fire("paged.cold_attend", layer=layer)
         b, t, hq, hs = q.shape
         cold = max(0, int(start_pos) - self.resident)
         if cold <= 0:

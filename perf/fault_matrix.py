@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Fault-injection matrix: every runtime injection point x fault kind against
+live CPU-mesh engines (wired into tier-1 via tests/test_fault_matrix.py).
+
+For each (point, kind) cell the harness installs a deterministic FaultSpec,
+drives a workload through the family that owns the point, uninstalls, and
+then asserts the INVARIANTS the resilience layer promises (docs/ROBUSTNESS.md):
+
+- the BatchEngine scheduler thread NEVER dies: a fault-free probe request
+  must complete normally after every cell;
+- no slot leak: every slot is free, the queue is empty, and no prefix-cache
+  lease stays pinned once the cell's requests are done;
+- the sequential / paged Engine stays usable: reset + a short fault-free
+  generation succeeds after every cell.
+
+Individual requests inside a cell MAY fail — that is the point of an
+injected error — the matrix only fails when the process-level invariants
+break. Run directly (`python perf/fault_matrix.py [--skip-paged]`): exit 0
+clean, 1 with failing cells on stderr, one JSON summary line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_llama_tpu.models.params import init_random_params  # noqa: E402
+from distributed_llama_tpu.models.spec import (ArchType, ModelSpec,  # noqa: E402
+                                               RopeType)
+from distributed_llama_tpu.quants import FloatType  # noqa: E402
+from distributed_llama_tpu.resilience import faults  # noqa: E402
+from distributed_llama_tpu.resilience.faults import FaultSpec  # noqa: E402
+from distributed_llama_tpu.runtime.sampler import Sampler  # noqa: E402
+
+KINDS = ("error", "transient", "latency")
+BATCH_POINTS = ("batch.submit", "batch.cache_seed", "batch.prefill",
+                "batch.dispatch", "batch.emit",
+                "device_loop.batched_dispatch")
+ENGINE_POINTS = ("engine.dispatch", "device_loop.dispatch")
+PAGED_POINTS = ("paged.append", "paged.cold_attend")
+# api.request is HTTP-layer; its shed/validation/drain behavior is asserted
+# against a live server in tests/test_resilience.py, not here.
+
+
+def _spec(seq_len=128):
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=seq_len, rope_type=RopeType.LLAMA).resolved()
+
+
+def _greedy(spec):
+    return Sampler(spec.vocab_size, temperature=0.0)
+
+
+def build_batch_engine():
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    return spec, BatchEngine(spec, params, slots=2, tp=1, superstep=4)
+
+
+def build_engine(paged: bool = False):
+    from distributed_llama_tpu.runtime.engine import Engine
+
+    spec = _spec(seq_len=256 if paged else 128)
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    kw = (dict(kv_cache_storage="host", kv_cache_resident=64) if paged
+          else {})
+    return spec, Engine(spec, params, tp=1, **kw)
+
+
+def _spec_for(point: str, kind: str) -> FaultSpec:
+    # count=2 bounds every cell: the fault fires, the stack reacts, and the
+    # cell's own workload can still make progress afterwards
+    return FaultSpec(point, kind=kind, count=2, delay_ms=10)
+
+
+def run_batch_cell(spec, be, point: str, kind: str) -> list[str]:
+    problems: list[str] = []
+    with faults.active(_spec_for(point, kind)):
+        reqs = []
+        for i in range(2):
+            try:
+                reqs.append(be.submit([1, 7 + i, 23, 5] + list(range(2, 12)),
+                                      8, _greedy(spec)))
+            except Exception:
+                pass  # batch.submit faults reject synchronously — expected
+        for r in reqs:
+            try:
+                r.wait(timeout=120)
+            except TimeoutError:
+                problems.append(f"{point}/{kind}: request hung (stuck slot)")
+            except Exception:
+                pass  # injected failure surfaced to the client — expected
+    faults.uninstall()
+    # invariants: scheduler alive, probe completes, nothing leaked
+    if not be.scheduler_alive():
+        problems.append(f"{point}/{kind}: scheduler thread DIED")
+        return problems
+    try:
+        probe = be.submit([1, 2, 3], 4, _greedy(spec))
+        out = probe.wait(timeout=120)
+        if len(out) != 4 or probe.error is not None:
+            problems.append(f"{point}/{kind}: probe degraded "
+                            f"({len(out)} tokens, err={probe.error!r})")
+    except Exception as e:
+        problems.append(f"{point}/{kind}: probe failed: {e!r}")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with be._plock:
+            leaked = [s for s in be._slots
+                      if s.req is not None or s.lease is not None]
+        if not leaked and not be._pending and be._queue.empty():
+            break
+        time.sleep(0.01)
+    else:
+        problems.append(f"{point}/{kind}: slot/lease leak after probe")
+    return problems
+
+
+def run_engine_cell(spec, eng, point: str, kind: str,
+                    paged: bool = False) -> list[str]:
+    problems: list[str] = []
+    prompt = ([1] + list(range(2, 82))) if paged else [1, 7, 23, 5]
+    with faults.active(_spec_for(point, kind)):
+        try:
+            eng.reset()
+            if point == "device_loop.dispatch":
+                eng.generate_with(list(prompt), 6, _greedy(spec),
+                                  device_loop_chunk=4)
+            else:
+                eng.generate(list(prompt), 6, _greedy(spec))
+        except Exception:
+            pass  # the request may fail; the ENGINE must survive
+    faults.uninstall()
+    try:
+        eng.reset()
+        out, _ = eng.generate(list(prompt), 2, _greedy(spec))
+        if len(out) != 2:
+            problems.append(f"{point}/{kind}: probe generated {len(out)}/2")
+    except Exception as e:
+        problems.append(f"{point}/{kind}: engine unusable after fault: {e!r}")
+    return problems
+
+
+def run_matrix(include_paged: bool = True,
+               kinds=KINDS) -> tuple[int, list[str]]:
+    cells = 0
+    problems: list[str] = []
+    bspec, be = build_batch_engine()
+    try:
+        for point in BATCH_POINTS:
+            for kind in kinds:
+                cells += 1
+                problems += run_batch_cell(bspec, be, point, kind)
+    finally:
+        be.close()
+    espec, eng = build_engine()
+    for point in ENGINE_POINTS:
+        for kind in kinds:
+            cells += 1
+            problems += run_engine_cell(espec, eng, point, kind)
+    if include_paged:
+        pspec, peng = build_engine(paged=True)
+        for point in PAGED_POINTS:
+            for kind in kinds:
+                cells += 1
+                problems += run_engine_cell(pspec, peng, point, kind,
+                                            paged=True)
+    return cells, problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-paged", action="store_true",
+                    help="skip the paged-engine family (its per-layer host "
+                         "callbacks dominate the matrix wall time)")
+    args = ap.parse_args()
+    t0 = time.perf_counter()
+    cells, problems = run_matrix(include_paged=not args.skip_paged)
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(json.dumps({"metric": "fault_matrix_cells", "value": cells,
+                      "unit": "cells", "vs_baseline": None,
+                      "failures": len(problems),
+                      "seconds": round(time.perf_counter() - t0, 1)}))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

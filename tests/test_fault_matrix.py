@@ -1,0 +1,33 @@
+"""Tier-1 wiring for perf/fault_matrix.py (ISSUE 4 satellite, the
+test_smoke_lint.py pattern): the full injection-point x fault-kind matrix
+runs against the CPU-mesh engines and must produce ZERO invariant
+violations — no scheduler-thread death, no slot/lease leak, no unusable
+engine after an injected fault."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "perf"))
+
+import fault_matrix  # noqa: E402
+
+
+def test_fault_matrix_no_scheduler_death_or_slot_leak():
+    cells, problems = fault_matrix.run_matrix(include_paged=True)
+    expected = (len(fault_matrix.BATCH_POINTS)
+                + len(fault_matrix.ENGINE_POINTS)
+                + len(fault_matrix.PAGED_POINTS)) * len(fault_matrix.KINDS)
+    assert cells == expected, (cells, expected)
+    assert not problems, "\n".join(problems)
+
+
+def test_matrix_covers_documented_inventory():
+    """Every runtime injection point named in docs/ROBUSTNESS.md must be in
+    the matrix — adding a fire() site without matrix coverage is exactly the
+    silent-cap failure mode this wrapper exists to prevent."""
+    covered = set(fault_matrix.BATCH_POINTS + fault_matrix.ENGINE_POINTS
+                  + fault_matrix.PAGED_POINTS)
+    doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "ROBUSTNESS.md")).read()
+    for point in covered:
+        assert f"`{point}`" in doc, f"{point} missing from docs/ROBUSTNESS.md"
