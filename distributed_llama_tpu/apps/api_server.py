@@ -145,6 +145,7 @@ def _stats_payload(state: "ApiState") -> dict:
     if be is not None:
         out["batch_engine"] = {
             "slots": be.slots_n, "superstep": be.superstep,
+            "pipeline": be.pipeline,
             "prefilled_tokens": be.prefilled_tokens,
             "decode_steps": be.decode_steps,
             "super_steps": be.super_steps,
@@ -663,6 +664,7 @@ def main(argv=None) -> None:
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
             slots=args.batch, superstep=max(args.superstep, 1),
+            pipeline=args.pipeline,
             prefix_cache=not args.no_prefix_cache,
             prefix_cache_blocks=args.prefix_cache_blocks,
             prefix_block_tokens=args.prefix_cache_block_tokens,
@@ -678,7 +680,8 @@ def main(argv=None) -> None:
         engine = None
         sampler = make_sampler(args, batch_engine.spec)
         print(f"⏩ Continuous batching: {args.batch} slots, "
-              f"super-step K={batch_engine.superstep}")
+              f"super-step K={batch_engine.superstep}, pipelined decode "
+              f"{'on' if batch_engine.pipeline else 'off'}")
     else:
         from .dllama import check_kv_storage
 

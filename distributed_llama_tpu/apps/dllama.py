@@ -89,6 +89,18 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                    help="fused 4-bit dequant-matmul for prefill and batched "
                         "decode (ops/pallas_q4_mm.py; also DLT_PREFILL_KERNEL=1) "
                         "— opt-in until the hardware A/B lands")
+    p.add_argument("--pipeline", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="pipelined super-steps for batched serving (--batch "
+                        "> 1, api_server/bench): eagerly chain decode "
+                        "dispatch N+1 from device-resident state (last "
+                        "token, positions, xorshift* RNG) while N's token "
+                        "block transfers and is delivered host-side, so the "
+                        "device never idles through EOS scans and callbacks; "
+                        "output stays token-identical (a diverging block "
+                        "flushes the in-flight dispatch). --no-pipeline "
+                        "restores the serialized host<->device loop "
+                        "(docs/SERVING.md \"Pipelined decode\")")
     p.add_argument("--device-loop", type=int, default=0, metavar="CHUNK",
                    help="decode CHUNK tokens per dispatch with the on-device scan loop "
                         "(runtime/device_loop.py); 0 = per-token host loop")

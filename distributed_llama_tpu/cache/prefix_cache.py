@@ -125,6 +125,18 @@ class PrefixCache:
         """Gather the lease's rows [skip, lease.tokens) as (K, V) host arrays
         of shape (L, hk, lease.tokens - skip, hs), ready to scatter into a
         slot's cache rows (`skip` = what the slot's own rewind already holds).
+        Views into one fetch_packed buffer — callers that scatter both halves
+        to device should use fetch_packed directly (one transfer)."""
+        packed = self.fetch_packed(lease, skip)
+        return packed[0], packed[1]
+
+    def fetch_packed(self, lease: PrefixLease, skip: int = 0) -> np.ndarray:
+        """Gather the lease's rows [skip, lease.tokens) as ONE contiguous
+        host buffer of shape (2, L, hk, n, hs) ([0] = K, [1] = V), each block
+        copied straight into place — no per-block concatenate + slice +
+        re-contiguize round trip — so the seeding path pays a single
+        host->device transfer and one scatter per cache tensor
+        (batch_engine._seed_from_cache).
 
         Runs OUTSIDE the facade lock: a cold fetch dequantizes Q80 buffers,
         which must not stall concurrent lookups/inserts. The lease's refs pin
@@ -132,13 +144,24 @@ class PrefixCache:
         refs), the caller owns the lease exclusively, and pool.get tolerates
         a concurrent demotion."""
         bt = self.block_tokens
+        n = lease.tokens - skip
         first = skip // bt
-        parts = [self.pool.get(node.handle) for node in lease.nodes[first:]]
-        k = np.concatenate([p[0] for p in parts], axis=2)
-        v = np.concatenate([p[1] for p in parts], axis=2)
         off = skip - first * bt
-        end = off + (lease.tokens - skip)
-        return k[:, :, off:end], v[:, :, off:end]
+        out = None
+        col = 0
+        for node in lease.nodes[first:]:
+            bk, bv = self.pool.get(node.handle)
+            if out is None:
+                L, hk, _, hs = bk.shape
+                out = np.empty((2, L, hk, n, hs), bk.dtype)
+            m = min(bk.shape[2] - off, n - col)
+            out[0, :, :, col:col + m] = bk[:, :, off:off + m]
+            out[1, :, :, col:col + m] = bv[:, :, off:off + m]
+            col += m
+            off = 0
+            if col >= n:
+                break
+        return out
 
     def mark_seeded(self, lease: PrefixLease, used_tokens: int) -> None:
         """The caller scattered this lease's rows into a slot: count the hit.

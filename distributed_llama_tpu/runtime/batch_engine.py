@@ -31,6 +31,18 @@ Design:
   (the over-decoded rows beyond it sit on masked slots and are overwritten by
   the slot's next writes — the same free-rollback property speculative
   decoding relies on).
+- PIPELINED super-steps (docs/SERVING.md "Pipelined decode"): the decode loop
+  returns its final carry (last token, positions, xorshift* state) as device
+  arrays, so super-step N+1 is issued CHAINED from N's device state before
+  N's (K, B) block has even reached the host — the device runs N+1 while the
+  host delivers N (EOS/stop scan, callbacks, sampler resync). When delivery
+  shows the speculated schedule diverged (a row stopped/cancelled/errored
+  mid-block, so N+1 decoded past the real frontier), the in-flight dispatch
+  is FLUSHED: its tokens are discarded via the same free frontier-rewind
+  rollback, clamp_pos keeps a context-end park from poisoning the prefix
+  harvest, and the next dispatch re-uploads host state (the sampler RNG
+  round-trips bit-exactly through a flush). Admission breaks the chain
+  instead of riding it, bounding admission latency at one in-flight window.
 - Sampling runs ON DEVICE inside the super-step with the host Sampler's
   xorshift* stream (state uploaded before, written back after), host-side
   elsewhere (prefill boundaries, single-step mode). Greedy super-steps emit
@@ -132,6 +144,25 @@ _DISPATCH_AGE = metrics.gauge(
     "batch_dispatch_age_seconds",
     "Dispatch watchdog: seconds since the scheduler last completed a device "
     "dispatch, 0 while idle (read at scrape time)")
+# Pipelined super-step telemetry (docs/SERVING.md "Pipelined decode"): the
+# gap histogram is the win (device-idle time between decode dispatches ->
+# ~0 when chained), the flush counter the cost (speculated device work
+# discarded when the host schedule diverged).
+_DISPATCH_GAP = metrics.histogram(
+    "batch_dispatch_gap_seconds",
+    "Device-idle gap before a decode super-step: host time between the "
+    "previous dispatch's results landing and this dispatch being issued "
+    "(0 when chained from device state while the predecessor is in flight)")
+_PIPELINE_DEPTH = metrics.gauge(
+    "batch_pipeline_depth",
+    "Decode super-steps currently in flight on device (2 = overlapped: one "
+    "executing while its predecessor's block is delivered host-side)")
+_PIPELINE_FLUSHES = metrics.counter(
+    "batch_pipeline_flushes_total",
+    "Pipeline breaks by reason: an eagerly chained super-step was discarded "
+    "before delivery (stop/cancel/error/finish — its rows diverged from the "
+    "speculated schedule) or chaining was declined (admission/close)",
+    labelnames=("reason",))
 
 
 @dataclass
@@ -204,6 +235,35 @@ class _Slot:
         self.clamp_pos: int | None = None
 
 
+class _InflightStep:
+    """An issued-but-undelivered K-step super-step dispatch.
+
+    Holds the DEVICE arrays the dispatch will produce (`toks` the (K, B)
+    token block, plus the (last_tok, pos, rng) carry the next dispatch can
+    chain from) and the host-side schedule it was issued against: full
+    B-length `starts`/`budget`/`temps` lists plus the (slot, request) pairs
+    of its live rows. A chained dispatch's schedule is SPECULATIVE — derived
+    assuming its predecessor delivers every budgeted token — and is validated
+    against the predecessor's actual delivery before this dispatch is kept."""
+
+    __slots__ = ("rows", "k", "starts", "budget", "temps", "toks", "tok",
+                 "pos", "rng", "t_issue", "chained")
+
+    def __init__(self, rows, k, starts, budget, temps, toks, tok, pos, rng,
+                 t_issue, chained):
+        self.rows = rows  # list[(slot, request)] for budget > 0 rows
+        self.k = k
+        self.starts = starts  # expected per-row device start positions
+        self.budget = budget
+        self.temps = temps
+        self.toks = toks  # device (K, B) token block
+        self.tok = tok  # device (B,) block-tail token (next dispatch's input)
+        self.pos = pos  # device (B,) positions after the budgeted ingestions
+        self.rng = rng  # device (B, 2) advanced xorshift* state
+        self.t_issue = t_issue
+        self.chained = chained
+
+
 class BatchEngine:
     """Engine-compatible construction (same spec/params arguments), `slots` sequences.
 
@@ -213,7 +273,7 @@ class BatchEngine:
     """
 
     def __init__(self, spec: ModelSpec, params, tokenizer=None, *, slots: int = 2,
-                 superstep: int = 8, prefix_cache=True,
+                 superstep: int = 8, pipeline: bool = True, prefix_cache=True,
                  prefix_cache_blocks: int = 0, prefix_block_tokens: int = 16,
                  prefix_cache_q80: bool = False, max_queue: int = 0,
                  queue_ttl: float = 0.0, max_retries: int = 3,
@@ -239,6 +299,13 @@ class BatchEngine:
         self.spec = spec
         self.tokenizer = tokenizer
         self.superstep = superstep  # K: decode steps fused per device dispatch
+        # pipelined super-steps (docs/SERVING.md "Pipelined decode"): chain
+        # dispatch N+1 from N's device-resident carry while N's block is
+        # delivered host-side. K=1 has no block to overlap; keep it off there.
+        self.pipeline = pipeline and superstep >= 2
+        self._inflight: _InflightStep | None = None
+        self._last_ready_t: float | None = None  # perf_counter of last results
+        self._gap_t: float | None = None  # last dispatch-ready time, gap metric
         self._slots = [_Slot(i) for i in range(slots)]
         self._queue: "queue.Queue[BatchRequest]" = queue.Queue()
         # overflow requests with no free slot; guarded by _plock (close() may run while
@@ -503,12 +570,17 @@ class BatchEngine:
             with trace.span("batch.prefix_seed",
                             {"slot": slot.index, "tokens": n,
                              "rewind": reuse}):
-                # fetch only the span the rewind doesn't already hold
-                ck, cv = self.prefix_cache.fetch(lease, skip=reuse)
+                # fetch only the span the rewind doesn't already hold, as ONE
+                # contiguous (2, L, hk, n-reuse, hs) buffer: a single
+                # host->device transfer and one scatter per cache tensor
+                # (previously: contiguize + upload + scatter per K/V half)
+                rows = jnp.asarray(
+                    self.prefix_cache.fetch_packed(lease, skip=reuse),
+                    eng.dtype)
                 eng.k_cache = eng.k_cache.at[:, slot.index, :, reuse:n, :].set(
-                    jnp.asarray(np.ascontiguousarray(ck), eng.dtype))
+                    rows[0])
                 eng.v_cache = eng.v_cache.at[:, slot.index, :, reuse:n, :].set(
-                    jnp.asarray(np.ascontiguousarray(cv), eng.dtype))
+                    rows[1])
         except Exception as e:
             self.prefix_cache.mark_unused(lease)
             from ..cache import warn_degraded
@@ -560,7 +632,11 @@ class BatchEngine:
                 eng.params, eng.rope, toks, eng.k_cache, eng.v_cache, start_pos)
             return np.asarray(logits)
 
-        return self._dispatched(kind, call)
+        out = self._dispatched(kind, call)
+        # sync dispatch: results are host-side now — the reference point the
+        # device-idle-gap histogram measures the next decode issue against
+        self._gap_t = time.perf_counter()
+        return out
 
     def _finish(self, slot: _Slot, finish: str) -> None:
         req = slot.req
@@ -725,6 +801,13 @@ class BatchEngine:
         (caches possibly indeterminate) — fail every in-flight request. The
         scheduler thread itself SURVIVES and keeps serving new admissions."""
         _ENGINE_ERRORS.labels(kind="engine").inc()
+        if self._inflight is not None:
+            # a chained dispatch issued against the now-failed schedule is
+            # garbage: drop its device refs; the next dispatch re-uploads
+            # host state (which _finish below makes authoritative)
+            _PIPELINE_FLUSHES.labels(reason="error").inc()
+            self._inflight = None
+            _PIPELINE_DEPTH.set(0)
         for s in self._slots:
             if s.req is not None:
                 s.req.error = e
@@ -750,6 +833,10 @@ class BatchEngine:
                         if not self._shutdown:
                             self._cond.wait(timeout=0.05)
         finally:
+            if self._inflight is not None:  # close() mid-pipeline
+                _PIPELINE_FLUSHES.labels(reason="close").inc()
+                self._inflight = None
+            _PIPELINE_DEPTH.set(0)
             _SCHED_ALIVE.set(0)
 
     def _loop_once(self) -> None:
@@ -759,7 +846,14 @@ class BatchEngine:
         active = [s for s in self._slots if s.req and not s.pending]
         _SLOTS_OCCUPIED.set(sum(1 for s in self._slots if s.req is not None))
         try:
-            if prefill:
+            if self._inflight is not None:
+                # a chained super-step is running on device: deliver it (and
+                # maybe chain its successor) before any new dispatch shape —
+                # every later device op already depends on its cache writes
+                fl = self._inflight
+                self._inflight = None
+                self._pipeline_advance(fl)
+            elif prefill:
                 victim = prefill[0]
                 try:
                     # mixed step: active decode rows ride the prefill dispatch
@@ -782,6 +876,7 @@ class BatchEngine:
                 # queued request cancelled while idle has no notifier);
                 # enqueue latency is set by the notify, not this number.
                 # 0.1 s also bounds queue-TTL/deadline detection while idle.
+                self._gap_t = None  # an idle device is not a starved one
                 with self._cond:
                     if self._queue.empty() and not self._shutdown:
                         self._cond.wait(timeout=0.1)
@@ -985,60 +1080,213 @@ class BatchEngine:
 
     def _super_step(self, active: list[_Slot], k: int,
                     budgets: dict[int, int]) -> None:
-        """One K-step fused dispatch: every active row decodes up to its budget
-        on device (sampling included), then the returned (K, B) block is
-        delivered host-side with EOS/stop/max checks per token. A row that
-        stops mid-block keeps its position at the verified frontier — the
-        over-decoded rows beyond it sit on masked slots and are overwritten by
-        the slot's next real writes (free rollback)."""
-        t0 = time.perf_counter()
-        eng = self._eng
-        s = self.spec.seq_len
+        """One K-step fused dispatch from host state: every active row decodes
+        up to its budget on device (sampling included), then the returned
+        (K, B) block is delivered host-side with EOS/stop/max checks per
+        token. A row that stops mid-block keeps its position at the verified
+        frontier — the over-decoded rows beyond it sit on masked slots and
+        are overwritten by the slot's next real writes (free rollback). With
+        pipelining, the NEXT super-step is chained from this one's device
+        carry before delivery starts (_pipeline_advance)."""
         starts = self._park_positions(1)
-        tokens = [0] * self.slots_n
         budget = [0] * self.slots_n
+        rows: list[tuple[_Slot, BatchRequest]] = []
+        for slot in active:
+            starts[slot.index] = slot.pos
+            budget[slot.index] = budgets[slot.index]
+            rows.append((slot, slot.req))
+        fl = self._issue_super_step(rows, k, budget, starts)
+        self._pipeline_advance(fl)
+
+    def _pipeline_advance(self, fl: _InflightStep) -> None:
+        """Drive one pipeline turn: optionally issue the super-step AFTER
+        `fl` chained from its device-resident carry (so the device never
+        idles through the host delivery loop below), then deliver `fl` and
+        validate the speculation — a chained dispatch survives only when
+        every row it decodes delivered its full budget and stayed live."""
+        nxt = None
+        plan = None
+        if self.pipeline and not self._shutdown and not self._draining:
+            plan = self._plan_chain(fl)
+        if plan is not None:
+            with self._plock:
+                waiting = bool(self._pending) or not self._queue.empty()
+            if waiting or any(s.req and s.pending for s in self._slots):
+                # a request needs the next dispatch for admission/prefill:
+                # break the chain instead of extending it — the pipelined
+                # analog of the K -> 1 admission-latency drop
+                _PIPELINE_FLUSHES.labels(reason="admission").inc()
+                plan = None
+        if plan is not None:
+            rows, starts, budget, clamp = plan
+            for slot in clamp:
+                # the chained scan parks this row clamped at seq_len-1,
+                # destroying that history row — flag it before fl's delivery
+                # so a mid-delivery _finish harvests the truncated prefix
+                slot.clamp_pos = self.spec.seq_len - 1
+            nxt = self._issue_super_step(rows, self.superstep, budget, starts,
+                                         chain=fl)
+        try:
+            status = self._deliver_super_step(fl)
+        except BaseException:
+            if nxt is not None:
+                # delivery failed with the chained dispatch still a local:
+                # account for it here — _fail_all only sees self._inflight
+                _PIPELINE_FLUSHES.labels(reason="error").inc()
+            _PIPELINE_DEPTH.set(0)
+            raise
+        if nxt is not None:
+            reason = self._chain_divergence(nxt, status)
+            if reason is not None:
+                self._flush_inflight(nxt, reason)
+            else:
+                self._inflight = nxt
+        _PIPELINE_DEPTH.set(1 if self._inflight is not None else 0)
+
+    def _plan_chain(self, fl: _InflightStep):
+        """Speculative schedule for the super-step after `fl`, assuming `fl`
+        delivers every budgeted token: same rows, re-derived budgets from the
+        expected positions/output lengths. Returns (rows, starts, budget,
+        clamp_slots), or None when no row would decode >= 2 steps (the
+        single-step / admission path takes over) or a reap is imminent."""
+        k = self.superstep
+        s = self.spec.seq_len
+        now = time.perf_counter()
+        starts = [st + b for st, b in zip(fl.starts, fl.budget)]
+        budget = [0] * self.slots_n
+        rows: list[tuple[_Slot, BatchRequest]] = []
+        clamp: list[_Slot] = []
+        for slot, req in fl.rows:
+            i = slot.index
+            if req.cancelled or (req.deadline_t and now >= req.deadline_t):
+                return None  # _reap_slots fires next pass: don't outrun it
+            exp_out = len(req.out) + fl.budget[i]
+            b = min(k, req.max_tokens - exp_out, s - starts[i])
+            if b > 0:
+                budget[i] = b
+                rows.append((slot, req))
+            elif starts[i] >= s:
+                clamp.append(slot)
+        if not rows or max(budget) < 2:
+            return None
+        return rows, starts, budget, clamp
+
+    def _issue_super_step(self, rows: list, k: int, budget: list[int],
+                          starts: list[int],
+                          chain: _InflightStep | None = None) -> _InflightStep:
+        """Dispatch one K-step batched decode WITHOUT waiting for results
+        (async device dispatch: the call returns future arrays). chain=None
+        uploads host state — slot last_token/pos plus each sampler's
+        xorshift* state — exactly like the unpipelined super-step did;
+        chain=<predecessor> feeds that dispatch's device-resident (last_tok,
+        pos, rng) carry straight back in, no host round trip, with
+        `starts`/`budget` the caller's speculative schedule."""
+        eng = self._eng
         temps = [0.0] * self.slots_n
         topps = [0.9] * self.slots_n
+        tokens = [0] * self.slots_n
         rng = np.zeros((self.slots_n, 2), np.uint32)
         greedy = True
-        for slot in active:
+        for slot, req in rows:
             i = slot.index
-            starts[i] = slot.pos
-            tokens[i] = slot.last_token
-            budget[i] = budgets[i]
-            smp = slot.req.sampler
+            smp = req.sampler
             temps[i] = float(getattr(smp, "temperature", 0.0))
             topps[i] = float(getattr(smp, "topp", 0.9))
-            state = int(getattr(smp, "state", 0)) & ((1 << 64) - 1)
-            rng[i] = state >> 32, state & 0xFFFFFFFF
             greedy = greedy and temps[i] == 0.0
+            if chain is None:
+                tokens[i] = slot.last_token
+                state = int(getattr(smp, "state", 0)) & ((1 << 64) - 1)
+                rng[i] = state >> 32, state & 0xFFFFFFFF
         mode = "greedy" if greedy else "sample"
-        window = eng._window_for(max(st + max(b, 1)
-                                     for st, b in zip(starts, budget)))
+        window = eng._window_for(min(max(st + max(b, 1)
+                                         for st, b in zip(starts, budget)),
+                                     self.spec.seq_len))
         loop = self._batched_loop(k, mode, window)
-        with trace.span("batch.super_step", {"k": k, "rows": len(active),
-                                             "tokens": sum(budget)}):
+        if chain is None:
+            tok_in, pos_in, rng_in = tokens, starts, rng
+            if self._gap_t is not None:
+                # device-idle gap: results of the previous dispatch landed at
+                # _gap_t and nothing ran on device until this issue
+                _DISPATCH_GAP.observe(max(time.perf_counter() - self._gap_t,
+                                          0.0))
+        else:
+            tok_in, pos_in, rng_in = chain.tok, chain.pos, chain.rng
+            _DISPATCH_GAP.observe(0.0)  # chained: the device never went idle
+        t_issue = time.perf_counter()
+        with trace.span("batch.super_step_issue",
+                        {"k": k, "rows": len(rows),
+                         "chained": chain is not None}):
             def call():
-                toks, rng_out, eng.k_cache, eng.v_cache = loop(
-                    eng.params, eng.rope, tokens, eng.k_cache, eng.v_cache,
-                    starts, rng, temps, topps, budget)
-                return np.asarray(toks), np.asarray(rng_out)
+                toks, tok, pos, rng_out, eng.k_cache, eng.v_cache = loop(
+                    eng.params, eng.rope, tok_in, eng.k_cache, eng.v_cache,
+                    pos_in, rng_in, temps, topps, budget)
+                return toks, tok, pos, rng_out
 
-            toks, rng_out = self._dispatched("super_step", call)  # (k, B)
+            toks, tok, pos, rng_out = self._dispatched("super_step", call)
+        _PIPELINE_DEPTH.set(2 if chain is not None else 1)
+        for a in (toks, rng_out):
+            try:  # start the non-blocking host copy now; delivery's
+                a.copy_to_host_async()  # np.asarray picks the buffer up
+            except Exception:  # an optimization hint only — e.g. dp-sharded
+                pass  # outputs may refuse the whole-array async copy
+        return _InflightStep(rows, k, starts, budget, temps, toks, tok, pos,
+                             rng_out, t_issue, chain is not None)
+
+    def _deliver_super_step(self, fl: _InflightStep) -> dict[int, str]:
+        """Host-side delivery of an issued super-step: block on the (K, B)
+        token transfer, then per row run EOS/stop/max checks, emit tokens,
+        and resync the sampler RNG (full delivery adopts the device state;
+        partial delivery replays exactly the delivered coins — bit-exact
+        either way). Returns per-slot-index outcomes — "alive" (full budget
+        delivered, request still decoding) or the finish reason — the
+        validity oracle for a dispatch chained from this one's carry."""
+        k = fl.k
+        s = self.spec.seq_len
+        with trace.span("batch.super_step", {"k": k, "rows": len(fl.rows),
+                                             "tokens": sum(fl.budget),
+                                             "chained": fl.chained}):
+            toks = np.asarray(fl.toks)  # (k, B): blocks until the device lands
+            rng_out = np.asarray(fl.rng)
+        t_ready = time.perf_counter()
+        self._last_dispatch_t = time.monotonic()
+        # device-span estimate: the device could not start this dispatch
+        # before it was issued, nor before the previous dispatch's results
+        # were ready. Under overlap the issue->ready wall includes the time
+        # spent queued behind the predecessor — which the host used for the
+        # predecessor's delivery loop; that hidden slice is overlap_ms.
+        base = fl.t_issue
+        if self._last_ready_t is not None and self._last_ready_t > base:
+            base = self._last_ready_t
+        dev_ms = max((t_ready - base) * 1000.0, 1e-6)
+        overlap_ms = (base - fl.t_issue) * 1000.0
+        self._last_ready_t = t_ready
+        self._gap_t = t_ready
         self.decode_steps += 1
         self.super_steps += 1
-        dt_ms = (time.perf_counter() - t0) * 1000.0
-        _DISP_SUPER.observe(dt_ms / 1000.0)
-        _SUPERSTEP_TOKENS.observe(sum(budget))
+        _DISP_SUPER.observe(dev_ms / 1000.0)
+        _SUPERSTEP_TOKENS.observe(sum(fl.budget))
         # rows that ride the scan without a live request park for all k steps;
         # rows with a short budget park for the steps past it
-        _PARKED_ROW_STEPS.inc(sum(k - budget[s.index] for s in active)
-                              + (self.slots_n - len(active)) * k)
-        for slot in active:
-            req = slot.req
+        _PARKED_ROW_STEPS.inc(self.slots_n * k - sum(fl.budget))
+        status: dict[int, str] = {}
+        for slot, req in fl.rows:
             i = slot.index
-            b = budget[i]
-            if b < k and starts[i] + b >= s:
+            b = fl.budget[i]
+            if slot.req is not req or req.done.is_set():
+                # reaped (cancel/deadline/close) between issue and delivery:
+                # the block was decoded past a frontier that no longer exists
+                _ROLLBACK_TOKENS.inc(b)
+                status[i] = "cancelled"
+                continue
+            if not self._advance_row(slot):
+                # chained dispatch: consume the PREVIOUS block's tail token
+                # (the device already fed it; this mirrors _decode_step's
+                # pre-issue advance). A cancel observed here lands the row in
+                # _finish and discards its block.
+                _ROLLBACK_TOKENS.inc(b)
+                status[i] = req.finish
+                continue
+            if b < k and fl.starts[i] + b >= s:
                 # the scan parked this row mid-block clamped at s-1, whose
                 # scratch writes destroyed that history row — record it BEFORE
                 # delivery: reaching pos == s finishes the request inside the
@@ -1048,8 +1296,9 @@ class BatchEngine:
             block = toks[:b, i].tolist()
             smp = req.sampler
             state0 = int(getattr(smp, "state", 0))
-            per_tok = dt_ms / b
-            req.stats.dispatch_ms.append(dt_ms)
+            per_tok = dev_ms / b
+            req.stats.dispatch_ms.append(dev_ms)
+            req.stats.overlap_ms.append(overlap_ms)
             x = slot.last_token  # ingested input of the block's first step
             slot.armed = False  # the scan ingested last_token's KV
             alive = True
@@ -1082,13 +1331,14 @@ class BatchEngine:
                 # the host delivered fewer (stop/cancel/error mid-block) — the
                 # tail sits on masked slots and is discarded
                 _ROLLBACK_TOKENS.inc(b - delivered)
-            if temps[i] != 0.0 and hasattr(smp, "state"):
+            if fl.temps[i] != 0.0 and hasattr(smp, "state"):
                 # resync the host sampler to the coins actually DELIVERED, not
                 # the full budget the device drew: a stop/cancel mid-block
                 # discards the tail, and the sequential stream never draws for
                 # discarded tokens (a caller-owned sampler reused across
                 # requests must see one unbroken sequence). For a fully
-                # delivered block this equals the device's returned state.
+                # delivered block this equals the device's returned state —
+                # which a chained successor is already carrying forward.
                 if alive and delivered == b:
                     smp.state = np.uint64((int(rng_out[i, 0]) << 32)
                                           | int(rng_out[i, 1]))
@@ -1109,3 +1359,29 @@ class BatchEngine:
                 # the _park_positions clamp, incl. the lease shrink
                 self._truncate_history(slot, slot.clamp_pos)
                 slot.clamp_pos = None
+            status[i] = "alive" if alive else req.finish
+        return status
+
+    def _chain_divergence(self, nxt: _InflightStep,
+                          status: dict[int, str]) -> str | None:
+        """None when every row the chained dispatch decodes matched the
+        speculated schedule (predecessor delivered its full budget and the
+        request is still live); otherwise the flush reason."""
+        for slot, _req in nxt.rows:
+            st = status.get(slot.index, "cancelled")
+            if st != "alive":
+                return {"stop": "stop", "cancelled": "cancel",
+                        "error": "error"}.get(st, "finish")
+        return None
+
+    def _flush_inflight(self, fl: _InflightStep, reason: str) -> None:
+        """Discard a chained dispatch whose speculated schedule diverged from
+        what its predecessor actually delivered. The rollback is free: every
+        write the flushed scan makes lands at or beyond its row's committed
+        frontier (masked scratch, overwritten by the slot's next real
+        writes), context-end parks were flagged via clamp_pos at issue, and
+        the next dispatch re-uploads tokens/positions/RNG from host state —
+        which delivery kept bit-exact (the xorshift* stream never advances
+        for discarded tokens)."""
+        _PIPELINE_FLUSHES.labels(reason=reason).inc()
+        _ROLLBACK_TOKENS.inc(sum(fl.budget))

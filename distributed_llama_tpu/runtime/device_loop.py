@@ -243,7 +243,16 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
 
     Builds fn(params, rope, tokens (B,), kc, vc, start_pos (B,), rng (B, 2)
     uint32 [hi, lo], temperature (B,), topp (B,), budget (B,)) ->
-    (tokens (n_steps, B), rng (B, 2), kc, vc).
+    (tokens (n_steps, B), last_tok (B,), pos (B,), rng (B, 2), kc, vc).
+
+    The (last_tok, pos, rng) trailer is the loop's final carry, returned as
+    DEVICE arrays: last_tok is each row's block-tail sample (its KV not yet
+    ingested — exactly the next dispatch's input token), pos the row's
+    position after its budgeted ingestions, rng the advanced xorshift*
+    state. A pipelined scheduler (runtime/batch_engine.py) feeds them
+    straight back as the next dispatch's (tokens, start_pos, rng) without
+    waiting for the (n_steps, B) block's host transfer, so super-step N+1
+    chains from N's device state while N is still being delivered host-side.
 
     Per-row carry: each row decodes at its own `start_pos` (continuous
     batching) and stops advancing after `budget[r]` steps — a parked row keeps
@@ -311,7 +320,7 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
         (tok, pos, sh, sl, kc, vc), toks = jax.lax.scan(
             step, (tokens, start_pos, rng_hi, rng_lo, kc, vc),
             jnp.arange(n_steps, dtype=jnp.int32))
-        return toks, sh, sl, kc, vc
+        return toks, tok, pos, sh, sl, kc, vc
 
     from ..compat import shard_map
 
@@ -321,7 +330,7 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
         loop, mesh=mesh,
         in_specs=(param_specs, P(), P(), row, kv_spec, kv_spec, row, row, row,
                   row, row, row),
-        out_specs=(toks_out, row, row, kv_spec, kv_spec),
+        out_specs=(toks_out, row, row, row, row, kv_spec, kv_spec),
         check_vma=False,
     )
     donate = (4, 5) if donate_cache else ()
@@ -331,11 +340,11 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
             topp, budget):
         faults.fire("device_loop.batched_dispatch", n_steps=n_steps)
         rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
-        toks, sh, sl, kc, vc = jitted(
+        toks, tok, pos, sh, sl, kc, vc = jitted(
             p, rope.cos, rope.sin, jnp.asarray(tokens, jnp.int32), kc, vc,
             jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(topp, jnp.float32), jnp.asarray(budget, jnp.int32))
-        return toks, jnp.stack([sh, sl], axis=1), kc, vc
+        return toks, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc
 
     return run

@@ -74,11 +74,20 @@ class GenerationStats:
     spec_drafted: int = 0
     spec_accepted: int = 0
     spec_step_ms: list[float] = field(default_factory=list)
-    # REAL per-dispatch wall times (one entry per device dispatch, however
-    # many tokens it covered) — the honest latency series next to the
-    # synthetic token_ms averages above. The same numbers feed the
-    # engine_dispatch_seconds / batch_dispatch_seconds histograms.
+    # REAL per-dispatch times (one entry per device dispatch, however many
+    # tokens it covered) — the honest latency series next to the synthetic
+    # token_ms averages above. The same numbers feed the
+    # engine_dispatch_seconds / batch_dispatch_seconds histograms. Under
+    # PIPELINED super-steps (runtime/batch_engine.py) a dispatch's wall time
+    # no longer equals its cost — the host delivers the previous block while
+    # it runs — so each entry is the DEVICE-SIDE span estimate (issue or
+    # predecessor-completion, whichever is later, to results-ready) and
+    # overlap_ms below records the hidden host slice per dispatch.
     dispatch_ms: list[float] = field(default_factory=list)
+    # per-SUPER-STEP milliseconds of wall clock that ran concurrently with
+    # the predecessor still executing on device (0.0 when not pipelined; one
+    # entry per super-step dispatch only — docs/OBSERVABILITY.md)
+    overlap_ms: list[float] = field(default_factory=list)
     sent_kbytes_per_token: float = 0.0
     recv_kbytes_per_token: float = 0.0
     # provenance of the S/R numbers: "modeled" = the analytic formula below;

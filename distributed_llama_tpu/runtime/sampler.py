@@ -62,9 +62,23 @@ class Sampler:
         idx = int(np.searchsorted(cdf, coin, side="right"))
         return min(idx, self.vocab_size - 1)
 
+    # first argpartition selection width: the topp=0.9 nucleus of a peaked
+    # softmax is almost always a handful of tokens, so one O(n) partition
+    # beats the old full-survivor O(n log n) sort per token — host sampling
+    # now sits directly on the delivery loop the pipelined batched scheduler
+    # overlaps with device decode (docs/SERVING.md "Pipelined decode")
+    _TOPP_SELECT = 64
+
     def _sample_topp(self, probs: np.ndarray, coin: float) -> int:
         """Nucleus sampling with the reference's cutoff pre-filter
-        (tokenizer.cpp:328-369)."""
+        (tokenizer.cpp:328-369), the sort taken over an np.argpartition
+        top-M selection instead of every pre-filter survivor. M doubles
+        until the selected mass covers topp (worst case: the full-sort
+        fallback, the exact old path). Bit-identical with _sample_topp_full:
+        the selection keeps EVERY survivor >= the partition pivot, so
+        boundary ties are all present and the stable (prob desc, index asc)
+        sort of the selection is exactly the full sort's prefix — same
+        cumsum partials, same crossing index, same pick."""
         n = len(probs)
         cutoff = (1.0 - self.topp) / (n - 1)
         idx = np.nonzero(probs >= cutoff)[0]
@@ -72,7 +86,39 @@ class Sampler:
             # degenerate params (huge temperature + tiny topp): nothing passes the
             # pre-filter; the reference indexes probindex[-1] (UB) — fall back to mult
             return self._sample_mult(probs, coin)
-        # descending sort by prob (stable, like the reference qsort by prob only)
+        p_all = probs[idx]
+        m = self._TOPP_SELECT
+        while True:
+            if m < len(idx):
+                part = np.argpartition(-p_all, m - 1)[:m]
+                pivot = p_all[part].min()  # the m-th largest survivor prob
+                cand = np.nonzero(p_all >= pivot)[0]
+                order = idx[cand[np.argsort(-p_all[cand], kind="stable")]]
+            else:
+                # descending sort by prob over every survivor (stable, like
+                # the reference qsort by prob only) — the pre-selection path
+                order = idx[np.argsort(-p_all, kind="stable")]
+            p = probs[order]
+            csum = np.cumsum(p)
+            cut = np.nonzero(csum > self.topp)[0]
+            if len(cut) == 0 and m < len(idx):
+                m *= 2  # selection mass short of topp: widen and retry
+                continue
+            last = cut[0] if len(cut) else len(p) - 1
+            r = coin * csum[last]
+            pick = int(np.searchsorted(csum[: last + 1], r, side="right"))
+            pick = min(pick, last)
+            return int(order[pick])
+
+    def _sample_topp_full(self, probs: np.ndarray, coin: float) -> int:
+        """The pre-selection full-survivor-sort nucleus path, kept verbatim
+        as the bit-identity oracle for _sample_topp (tests/test_pipeline.py
+        asserts new == old over adversarial tie-heavy distributions)."""
+        n = len(probs)
+        cutoff = (1.0 - self.topp) / (n - 1)
+        idx = np.nonzero(probs >= cutoff)[0]
+        if len(idx) == 0:
+            return self._sample_mult(probs, coin)
         order = idx[np.argsort(-probs[idx], kind="stable")]
         p = probs[order]
         csum = np.cumsum(p)

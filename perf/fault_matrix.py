@@ -57,12 +57,13 @@ def _greedy(spec):
     return Sampler(spec.vocab_size, temperature=0.0)
 
 
-def build_batch_engine():
+def build_batch_engine(pipeline: bool = True):
     from distributed_llama_tpu.runtime.batch_engine import BatchEngine
 
     spec = _spec()
     params = init_random_params(spec, FloatType.Q40, seed=11)
-    return spec, BatchEngine(spec, params, slots=2, tp=1, superstep=4)
+    return spec, BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                             pipeline=pipeline)
 
 
 def build_engine(paged: bool = False):
@@ -153,14 +154,21 @@ def run_matrix(include_paged: bool = True,
                kinds=KINDS) -> tuple[int, list[str]]:
     cells = 0
     problems: list[str] = []
-    bspec, be = build_batch_engine()
-    try:
-        for point in BATCH_POINTS:
-            for kind in kinds:
-                cells += 1
-                problems += run_batch_cell(bspec, be, point, kind)
-    finally:
-        be.close()
+    # the batch family runs TWICE — pipelined (the default: overlapped
+    # dispatches, speculative chains that faults must flush cleanly) and
+    # serialized — so every cell's invariants hold under both schedulers
+    for pipeline in (True, False):
+        bspec, be = build_batch_engine(pipeline=pipeline)
+        tag = "pipelined" if pipeline else "serialized"
+        try:
+            for point in BATCH_POINTS:
+                for kind in kinds:
+                    cells += 1
+                    problems += [f"[{tag}] {p}"
+                                 for p in run_batch_cell(bspec, be, point,
+                                                         kind)]
+        finally:
+            be.close()
     espec, eng = build_engine()
     for point in ENGINE_POINTS:
         for kind in kinds:

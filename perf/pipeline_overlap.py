@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Smoke: pipelined super-steps must actually hide host delivery work, and a
+flush-heavy workload must never wedge the scheduler (ISSUE 5 CI gate).
+
+Two assertions against live CPU-mesh BatchEngines:
+
+1. OVERLAP — the same decode workload (per-token host callback doing real
+   work, the streaming/delivery cost pipelining exists to hide) runs against
+   a pipelined and an unpipelined engine; the mean device-idle gap
+   (`batch_dispatch_gap_seconds` delta per engine) with pipelining must be
+   < 50% of the unpipelined gap. Chained issues record a literal 0 gap, so
+   this fails only if the pipeline stops engaging.
+
+2. FLUSH-STORM SAFETY — a stream of 1-token (and boundary-2-token) requests,
+   interleaved with mid-block stop_check stops, maximizes schedule
+   divergence: every block ends a request, so chains flush or never form.
+   All requests must complete, no slot/lease may leak, and the scheduler
+   thread must survive.
+
+Run: JAX_PLATFORMS=cpu python perf/pipeline_overlap.py
+Prints one JSON line (bench.py convention); exit 0 pass, 1 fail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_llama_tpu.models.params import init_random_params  # noqa: E402
+from distributed_llama_tpu.models.spec import (ArchType, ModelSpec,  # noqa: E402
+                                               RopeType)
+from distributed_llama_tpu.obs import metrics  # noqa: E402
+from distributed_llama_tpu.quants import FloatType  # noqa: E402
+from distributed_llama_tpu.runtime.batch_engine import BatchEngine  # noqa: E402
+from distributed_llama_tpu.runtime.sampler import Sampler  # noqa: E402
+
+GEN = 64  # decoded tokens per request in the overlap phase
+CALLBACK_S = 0.0005  # per-token host work (emulated streaming/delivery cost)
+
+
+def _spec(seq_len=256):
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=seq_len, rope_type=RopeType.LLAMA).resolved()
+
+
+def _greedy(spec):
+    return Sampler(spec.vocab_size, temperature=0.0)
+
+
+def _gap_state():
+    h = metrics.snapshot().get("batch_dispatch_gap_seconds") or {}
+    return h.get("count", 0), h.get("sum", 0.0)
+
+
+def measure_gap(spec, params, pipeline: bool) -> tuple[float, int]:
+    """Mean device-idle gap (seconds) over one warmed decode run, and the
+    number of gap observations it covered."""
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=8,
+                     pipeline=pipeline, prefix_cache=False)
+    try:
+        def slow_token(_t):  # the host work the pipeline should hide
+            t_end = time.perf_counter() + CALLBACK_S
+            while time.perf_counter() < t_end:
+                pass
+
+        be.generate([1, 7, 23, 5], 2 * be.superstep, _greedy(spec))  # warm
+        c0, s0 = _gap_state()
+        r = be.submit([1, 7, 23, 5], GEN, _greedy(spec), on_token=slow_token)
+        out = r.wait(timeout=300)
+        c1, s1 = _gap_state()
+        assert len(out) == GEN, (pipeline, len(out))
+        n = max(c1 - c0, 1)
+        return (s1 - s0) / n, c1 - c0
+    finally:
+        be.close()
+
+
+def flush_storm(spec, params) -> list[str]:
+    """1-token request stream against a pipelined engine: every super-step
+    block ends its request, so any chained dispatch is flushed (or chaining
+    is declined for admission). Asserts completion + zero leaks."""
+    problems: list[str] = []
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=8, pipeline=True,
+                     prefix_cache=False)
+
+    def want_tokens(i: int) -> int:
+        return (1, 2, 4)[i % 3]  # 4 = mid-block stop (stop_check at token 4)
+
+    try:
+        reqs = []
+        for i in range(48):
+            stop = None
+            if i % 3 == 2:  # 16-token ask stopped mid-block by its 4th token
+                stop = lambda t, seen=[]: (seen.append(t) or len(seen) >= 4)
+            reqs.append(be.submit([1, 3 + (i % 50), 7],
+                                  16 if stop else want_tokens(i),
+                                  _greedy(spec), stop_check=stop))
+        for i, r in enumerate(reqs):
+            try:
+                out = r.wait(timeout=120)
+                if len(out) != want_tokens(i):
+                    problems.append(f"req {i}: {len(out)} tokens, "
+                                    f"wanted {want_tokens(i)}")
+            except Exception as e:
+                problems.append(f"req {i}: {e!r}")
+        if not be.scheduler_alive():
+            problems.append("scheduler thread DIED during the storm")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with be._plock:
+                leaked = [s for s in be._slots
+                          if s.req is not None or s.lease is not None]
+            if not leaked and not be._pending and be._queue.empty():
+                break
+            time.sleep(0.01)
+        else:
+            problems.append("slot/lease leak after the storm")
+    finally:
+        be.close()
+    return problems
+
+
+def main() -> int:
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    gap_off, n_off = measure_gap(spec, params, pipeline=False)
+    gap_on, n_on = measure_gap(spec, params, pipeline=True)
+    ratio = gap_on / max(gap_off, 1e-12)
+    ok_gap = ratio < 0.5
+    problems = flush_storm(spec, params)
+    flushes = sum((metrics.snapshot().get("batch_pipeline_flushes_total")
+                   or {}).values())
+    ok = ok_gap and not problems
+    print(json.dumps({
+        "metric": "pipeline_gap_ratio", "value": round(ratio, 4),
+        "unit": "fraction", "pass": ok, "threshold": 0.5,
+        "gap_on_us": round(gap_on * 1e6, 1),
+        "gap_off_us": round(gap_off * 1e6, 1),
+        "gap_samples": [n_off, n_on],
+        "storm_problems": len(problems), "pipeline_flushes": flushes,
+    }))
+    if not ok_gap:
+        print(f"FAIL: pipelined mean gap {gap_on * 1e6:.0f} µs is {ratio:.0%} "
+              f"of the unpipelined {gap_off * 1e6:.0f} µs (budget 50%)",
+              file=sys.stderr)
+    for p in problems:
+        print(f"FAIL: {p}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

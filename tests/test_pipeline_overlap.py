@@ -1,0 +1,34 @@
+"""Tier-1 wiring for perf/pipeline_overlap.py (ISSUE 5 satellite, the
+test_smoke_lint.py pattern): pipelined super-steps must cut the device-idle
+gap to < 50% of the unpipelined scheduler's on the CPU mesh, and a stream of
+1-token requests (maximum flush pressure) must complete without deadlock or
+slot/lease leak."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "perf"))
+
+import pipeline_overlap  # noqa: E402
+
+
+def test_pipeline_halves_device_idle_gap():
+    spec = pipeline_overlap._spec()
+    from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.quants import FloatType
+
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    gap_off, n_off = pipeline_overlap.measure_gap(spec, params, pipeline=False)
+    gap_on, n_on = pipeline_overlap.measure_gap(spec, params, pipeline=True)
+    assert n_off > 0 and n_on > 0
+    assert gap_on < 0.5 * gap_off, (gap_on, gap_off)
+
+
+def test_flush_storm_no_deadlock_no_leak():
+    spec = pipeline_overlap._spec()
+    from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.quants import FloatType
+
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    problems = pipeline_overlap.flush_storm(spec, params)
+    assert not problems, "\n".join(problems)
