@@ -378,6 +378,291 @@ def shared_prefix_workload(args, spec):
     }))
 
 
+def _write_fleet_model(outdir: str) -> tuple[str, str]:
+    """Tiny real-format checkpoint + chatml byte-level tokenizer for the fleet
+    replicas (the examples/make_tiny_model.py pattern, sized for fast CPU
+    startup: the fleet bench measures ROUTING + cache locality, not kernels)."""
+    from distributed_llama_tpu.formats.mfile import params_file_order, write_model
+    from distributed_llama_tpu.formats.tfile import TokenizerData, write_tokenizer
+    from distributed_llama_tpu.models.params import init_random_params
+
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=262,
+                     seq_len=512, rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=6)
+    mpath = os.path.join(outdir, "fleet.m")
+    write_model(mpath, spec, params_file_order(spec, params), FloatType.F32)
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)] + \
+        [b"<|im_start|>", b"<|im_end|>", b" "]
+    scores = [0.0] * 259 + [-1.0, -1.0, -1.5]
+    tpath = os.path.join(outdir, "fleet.t")
+    write_tokenizer(tpath, TokenizerData(
+        vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=260,
+        max_token_length=12, chat_template="{{<|im_start|>}}"))
+    return mpath, tpath
+
+
+def fleet_shared_prefix_workload(args, spec):
+    """--workload shared-prefix --replicas N [--routing affinity|random]
+    [--kill-replica]: the fleet-tier acceptance bench (docs/FLEET.md).
+
+    Launches N real api_server subprocesses (tiny synthetic checkpoint, CPU)
+    plus the in-process fleet router, then drives G shared-prefix request
+    groups through the router: one warm request per group, then concurrent
+    streaming followers. Reports fleet tok/s (delivered deltas / wall), TTFT
+    p50/p95, the AGGREGATE prefix-hit-rate summed over every replica's
+    /v1/stats prefix_cache counters, and the router's routes-by-reason
+    split. `--routing random` is the A/B control (affinity must beat it);
+    `--kill-replica` SIGTERMs one replica mid-run — graceful drain + router
+    failover must complete EVERY request with no client-visible failure."""
+    import http.client
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    from distributed_llama_tpu.fleet.router import close_router, serve_router
+    from distributed_llama_tpu.obs import metrics as obs_metrics
+
+    n_rep = args.replicas
+    tmp = tempfile.mkdtemp(prefix="dlt_fleet_")
+    mpath, tpath = _write_fleet_model(tmp)
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    ports = [free_port() for _ in range(n_rep)]
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root,
+               DLT_HANDOFF_PATH="", DLLAMA_FAULTS="", DLLAMA_FAULT_SEED="")
+    procs, logs = [], []
+    for port in ports:
+        log = open(os.path.join(tmp, f"replica_{port}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "distributed_llama_tpu.apps.api_server",
+             "--model", mpath, "--tokenizer", tpath, "--chat-template",
+             "chatml", "--host", "127.0.0.1", "--port", str(port),
+             "--batch", "2", "--superstep", "4", "--drain-timeout", "60"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=repo_root))
+
+    def _get_json(port, path, timeout=10):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    router = None
+    try:
+        deadline = time.time() + 300
+        for port, proc in zip(ports, procs):
+            while True:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica :{port} died during startup "
+                        f"(see {tmp}/replica_{port}.log)")
+                try:
+                    if _get_json(port, "/healthz", timeout=2)[0] == 200:
+                        break
+                except OSError:
+                    pass
+                if time.time() > deadline:
+                    raise RuntimeError(f"replica :{port} never became healthy")
+                time.sleep(0.5)
+        router = serve_router([f"127.0.0.1:{p}" for p in ports],
+                              host="127.0.0.1", port=0, policy=args.routing,
+                              poll_interval=0.5, block_bytes=32, retries=2,
+                              try_timeout=120.0, seed=0)
+        rport = router.server_address[1]
+        threading.Thread(target=router.serve_forever, daemon=True).start()
+
+        rng = np.random.default_rng(0)
+        # more groups than any replica has slots (2 each): slots churn across
+        # groups, so reuse flows through the RADIX pool (counted in
+        # hit_tokens) rather than the same-slot resident rewind (which the
+        # cache reports as unused_hits). The group count is a CONSTANT —
+        # fleet-size-independent — so --replicas 1 (the single-replica
+        # baseline) and --replicas N run the IDENTICAL request schedule;
+        # only the routing changes, which is exactly what the acceptance
+        # comparison isolates
+        groups = 8
+        # ~args.shared_prefix chars -> ~that many tokens via the byte-fallback
+        # tokenizer; budget under the replica seq_len (512)
+        sys_len = min(args.shared_prefix, 320)
+        systems = ["".join(rng.choice(list("abcdefgh rstlne"))
+                           for _ in range(sys_len)) for _ in range(groups)]
+        gen = 8
+        followers = max(args.requests - 1, 4)  # per group, measured phase
+
+        def one_request(system, user, results, idx):
+            t0 = time.perf_counter()
+            body = {"messages": [{"role": "system", "content": system},
+                                 {"role": "user", "content": user}],
+                    "max_tokens": gen, "temperature": 0, "stream": True}
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                                  timeout=180)
+                conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    results[idx] = {"error": f"status {resp.status}"}
+                    return
+                # read the SSE stream INCREMENTALLY (readline honors chunked
+                # decoding) so TTFT is the first delta's true arrival time
+                ttft, deltas = None, 0
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    line = line.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    payload = json.loads(line[6:])
+                    if "error" in payload:
+                        results[idx] = {"error": payload["error"]}
+                        return
+                    if payload["choices"][0]["delta"].get("content"):
+                        deltas += 1
+                        if ttft is None:
+                            ttft = time.perf_counter() - t0
+                results[idx] = {"ttft": ttft, "deltas": deltas}
+            except Exception as e:
+                results[idx] = {"error": repr(e)}
+            finally:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+        # warm phase: one request per group, sequential — inserts each
+        # group's system prompt into SOME replica's cache and (affinity
+        # mode) records the route
+        warm = [None] * groups
+        for g, system in enumerate(systems):
+            one_request(system, f"warm {g}", warm, g)
+            assert "error" not in (warm[g] or {"error": "no result"}), warm[g]
+
+        victim_stats = {}
+        kill_at = None
+        if args.kill_replica:
+            kill_at = (groups * followers) // 2
+
+        # measured phase: followers interleaved across groups, concurrent
+        reqs = [(g, f) for f in range(followers) for g in range(groups)]
+        results = [None] * len(reqs)
+        threads = []
+        t_all0 = time.perf_counter()
+        sem = threading.Semaphore(2 * n_rep)  # fleet-wide client concurrency
+
+        def run_one(i, g, f):
+            with sem:
+                one_request(systems[g], f"follower {f} of group {g}",
+                            results, i)
+
+        for i, (g, f) in enumerate(reqs):
+            if kill_at is not None and i == kill_at:
+                # mid-bench replica kill: snapshot its cache counters, then
+                # SIGTERM (graceful drain -> router reroutes; in-flight
+                # requests finish on the draining replica)
+                _, victim_stats = _get_json(ports[0], "/v1/stats", timeout=10)
+                procs[0].send_signal(signal.SIGTERM)
+            t = threading.Thread(target=run_one, args=(i, g, f))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=300)
+        wall = time.perf_counter() - t_all0
+
+        failed = [(i, r) for i, r in enumerate(results)
+                  if r is None or "error" in r]
+        ttfts = sorted(r["ttft"] for r in results
+                       if r and r.get("ttft") is not None)
+        deltas = sum(r.get("deltas", 0) for r in results if r)
+
+        # aggregate prefix-hit-rate over every replica (the victim from its
+        # pre-kill snapshot; survivors live — the victim is NEVER polled
+        # live, even while it is still draining, or its counters would be
+        # summed twice)
+        hit_tok = resident_tok = prompt_tok = 0.0
+        per_replica_hits = {}
+        stats_sources = ([(f"127.0.0.1:{ports[0]}", victim_stats)]
+                         if victim_stats else [])
+        for port, proc in zip(ports, procs):
+            if victim_stats and port == ports[0]:
+                continue
+            if proc.poll() is None:
+                try:
+                    stats_sources.append(
+                        (f"127.0.0.1:{port}",
+                         _get_json(port, "/v1/stats", timeout=10)[1]))
+                except OSError:
+                    pass
+        for rep_id, st in stats_sources:
+            pc = st.get("prefix_cache") or {}
+            hit_tok += pc.get("hit_tokens", 0)
+            resident_tok += pc.get("resident_tokens", 0)
+            prompt_tok += pc.get("prompt_tokens", 0)
+            per_replica_hits[rep_id] = {
+                "reuse_rate": round(pc.get("reuse_rate", 0.0), 3),
+                "hit_tokens": pc.get("hit_tokens", 0),
+                "resident_tokens": pc.get("resident_tokens", 0)}
+        routes = {k.split("=")[1].strip('"}'): v for k, v in
+                  (obs_metrics.snapshot().get("router_routes_total")
+                   or {}).items()}
+        print(json.dumps({
+            "metric": "fleet_shared_prefix_tok_s",
+            "value": round(deltas / wall, 2) if wall else 0.0,
+            "unit": "tok/s", "vs_baseline": None,
+            "routing": args.routing, "replicas": n_rep,
+            "killed_replica": bool(args.kill_replica),
+            "failed_requests": len(failed),
+            "failures": [f"{i}: {r}" for i, r in failed[:5]],
+            "requests": len(reqs), "groups": groups,
+            "followers_per_group": followers,
+            "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 2)
+            if ttfts else None,
+            "ttft_p95_ms": round(
+                ttfts[min(int(len(ttfts) * 0.95), len(ttfts) - 1)] * 1e3, 2)
+            if ttfts else None,
+            # reuse = pool hits + resident rewinds: WHICH mechanism skipped a
+            # request's prefill is a slot-scheduling accident (the same sticky
+            # route lands either way), so the acceptance metric sums both;
+            # prefix_hit_rate (pool only) is kept for the PR 3 comparison
+            "prefix_reuse_rate": round(
+                (hit_tok + resident_tok) / prompt_tok, 3)
+            if prompt_tok else 0.0,
+            "prefix_hit_rate": round(hit_tok / prompt_tok, 3)
+            if prompt_tok else 0.0,
+            "per_replica": per_replica_hits,
+            "routes": routes,
+            "shared_prefix_chars": sys_len, "gen_tokens": gen,
+        }))
+        if failed:
+            sys.exit(1)
+    finally:
+        if router is not None:
+            close_router(router)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for log in logs:
+            log.close()
+
+
 def batched_engine_bench(args, spec):
     """--batch B --pipeline/--no-pipeline: serving decode throughput measured
     through the REAL BatchEngine scheduler — admission, device dispatch, and
@@ -679,6 +964,22 @@ def main():
     ap.add_argument("--requests", type=int, default=5, metavar="N",
                     help="shared-prefix workload: total requests (1 warm + N-1 "
                          "concurrent followers)")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="shared-prefix workload: run the FLEET tier — N real "
+                         "api_server subprocesses fronted by the in-process "
+                         "prefix-affinity router (docs/FLEET.md); reports "
+                         "fleet tok/s, TTFT p50/p95 and the aggregate "
+                         "prefix-hit-rate over all replicas")
+    ap.add_argument("--routing", choices=("affinity", "random"),
+                    default="affinity",
+                    help="fleet replica selection: 'affinity' (prefix-"
+                         "locality, least-loaded fallback) vs the 'random' "
+                         "A/B control")
+    ap.add_argument("--kill-replica", action="store_true",
+                    help="fleet workload: SIGTERM one replica halfway through "
+                         "the measured phase — graceful drain + router "
+                         "failover must complete every request (exit 1 on any "
+                         "client-visible failure)")
     ap.add_argument("--shared-prefix", type=int, default=192, metavar="T",
                     help="shared-prefix workload: tokens in the common system "
                          "prompt (clamped to fit seq_len)")
@@ -727,7 +1028,7 @@ def main():
         for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
                   "window", "cache_write", "no_fuse", "prologue",
                   "prefill_kernel", "kv_paged", "batch", "superstep", "trace",
-                  "workload", "pipeline")
+                  "workload", "pipeline", "replicas")
     ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
     if args.batch > 0 and (args.prefill > 0 or args.device_loop > 0
                            or args.kv_paged > 0):
@@ -738,6 +1039,12 @@ def main():
         ap.error(f"--workload {args.workload} is its own mode; combine only "
                  "with --small/--arch/--batch/--superstep/--requests/"
                  "--shared-prefix/--fault-rate/--tp")
+    if args.replicas and args.workload != "shared-prefix":
+        ap.error("--replicas N is the fleet tier of "
+                 "--workload shared-prefix (docs/FLEET.md); N=1 is the "
+                 "single-replica baseline the acceptance compares against")
+    if args.kill_replica and not args.replicas:
+        ap.error("--kill-replica requires --replicas N")
     if args.kv_paged > 0 and args.tp > 1:
         # before any mesh/device work so the error beats a mesh-size crash
         ap.error("--kv-paged is single-chip (the paged step is an unsharded "
@@ -854,7 +1161,13 @@ def main():
     on_tpu = backend == "tpu"
     spec = ModelSpec(**(SMALL if args.small else ARCHS[args.arch])).resolved()
     if args.workload == "shared-prefix":
-        shared_prefix_workload(args, spec)
+        if args.replicas >= 1:
+            # --replicas 1 is the single-replica fleet baseline: the SAME
+            # request schedule + router proxy, so the N>=2 comparison isolates
+            # routing (docs/FLEET.md); 0 = the in-process PR 3 workload
+            fleet_shared_prefix_workload(args, spec)
+        else:
+            shared_prefix_workload(args, spec)
         return
     if args.workload == "chaos":
         chaos_workload(args, spec)

@@ -7,4 +7,16 @@ the reference blueprint and the mapping from its layers to this package.
 
 __version__ = "0.1.0"
 
-from .quants import FloatType, QTensor  # noqa: F401
+__all__ = ["FloatType", "QTensor"]
+
+
+def __getattr__(name: str):
+    # lazy re-exports (PEP 562): importing the package must not pull in
+    # quants/jax — the fleet router (apps/router.py) is a pure-stdlib process
+    # that imports distributed_llama_tpu.fleet without ever loading a device
+    # runtime
+    if name in __all__:
+        from . import quants
+
+        return getattr(quants, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

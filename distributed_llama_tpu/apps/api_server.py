@@ -60,6 +60,19 @@ def _count_http(path: str, code: int) -> None:
     _HTTP.labels(route=route, code=str(code)).inc()
 
 
+def model_config_hash(spec) -> str:
+    """Stable short hash of the model configuration — the replica-identity
+    field fleet routers compare to catch a replica serving a different model
+    than the rest of the fleet (docs/FLEET.md). Hashes the ModelSpec fields
+    (enums stringified), not the weights: it identifies the config."""
+    import dataclasses
+    import hashlib
+
+    d = {f.name: str(getattr(spec, f.name))
+         for f in dataclasses.fields(spec)}
+    return hashlib.sha1(json.dumps(d, sort_keys=True).encode()).hexdigest()[:12]
+
+
 class ApiState:
     def __init__(self, engine: Engine, template_type: TemplateType,
                  default_sampler: Sampler, device_loop_chunk: int = 0,
@@ -68,6 +81,9 @@ class ApiState:
                  prefix_block_tokens: int = 16, prefix_cache_q80: bool = False,
                  request_deadline: float = 0.0):
         self.engine = engine
+        # replica identity (docs/FLEET.md): set to host:port once the server
+        # socket binds (serve()); what the router's membership poller reads
+        self.replica_id = ""
         self.batch_engine = batch_engine  # BatchEngine when --batch > 1, else None
         self.lock = threading.Lock()
         # graceful drain (docs/ROBUSTNESS.md): set by begin_drain/SIGTERM —
@@ -131,11 +147,34 @@ def _chunk_payload(state: ApiState, completion_id: str, delta: dict,
     }
 
 
+def _load_block(state: "ApiState") -> dict:
+    """Replica identity + load block served inside /healthz and /v1/stats —
+    what a fleet router's membership poller consumes (fleet/membership.py):
+    who this replica is (id, model config hash) and how loaded it is (slot
+    count, free slots, queue depth, draining). Cheap: no device work."""
+    be = state.batch_engine
+    if be is not None:
+        load = be.load_stats()
+        draining = state.draining or be.draining
+    else:
+        # single-engine mode: one slot, "free" == the generation lock is
+        # not held; there is no queue (requests serialize on the lock)
+        locked = state.lock.locked()
+        load = {"slots": 1, "free_slots": 0 if locked else 1,
+                "queue_depth": 0}
+        draining = state.draining
+    spec = (be or state.engine).spec
+    return {"id": state.replica_id, "model": state.model_name,
+            "model_hash": model_config_hash(spec),
+            "batched": be is not None, "draining": bool(draining), **load}
+
+
 def _stats_payload(state: "ApiState") -> dict:
     """GET /v1/stats: one JSON snapshot of every metric plus scheduler/engine
     state — the same numbers as /metrics, shaped for humans and scripts
     rather than a Prometheus scraper."""
     out: dict = {"model": state.model_name, "time": _now(),
+                 "replica": _load_block(state),
                  "metrics": metrics.snapshot()}
     be = state.batch_engine
     pc = (be.prefix_cache if be is not None
@@ -398,13 +437,15 @@ class Handler(BaseHTTPRequestHandler):
             # "unhealthy" when the batch scheduler thread died.
             be = self.state.batch_engine
             alive = be is None or be.scheduler_alive()
+            replica = _load_block(self.state)  # identity+load for routers
             if self.state.draining or (be is not None and be.draining):
-                self._json(503, {"status": "draining"})
+                self._json(503, {"status": "draining", "replica": replica})
             elif not alive:
                 self._json(503, {"status": "unhealthy",
-                                 "reason": "scheduler thread dead"})
+                                 "reason": "scheduler thread dead",
+                                 "replica": replica})
             else:
-                self._json(200, {"status": "ok"})
+                self._json(200, {"status": "ok", "replica": replica})
         elif self.path == "/metrics":
             self._raw(200, "text/plain; version=0.0.4; charset=utf-8",
                       metrics.render().encode())
@@ -518,6 +559,8 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = ThreadingHTTPServer((host, port), handler)
     server.api_state = state  # drain controller / tests reach the state here
+    # bound port is only known now (port=0 binds ephemeral in tests/benches)
+    state.replica_id = f"{host}:{server.server_address[1]}"
     print(f"🟢 dllama-api listening on {host}:{port}")
     return server
 

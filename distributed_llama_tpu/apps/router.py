@@ -1,0 +1,85 @@
+"""Fleet router entry point — front N api_server replicas with one process.
+
+    python -m distributed_llama_tpu.apps.router \
+        --replica 10.0.0.1:9990 --replica 10.0.0.2:9990 --port 9900
+
+No model, no device, no jax work: the router only needs the fleet/ package
+(stdlib HTTP + the shared radix trie). Replicas are ordinary api_server
+processes; their SIGTERM graceful drain (docs/ROBUSTNESS.md) composes with
+the router's membership poller into zero-downtime rolling restarts — drain a
+replica, the router stops routing to it, restart it, it rejoins. See
+docs/FLEET.md for the topology and routing policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from ..fleet.router import close_router, serve_router
+from ..resilience import faults
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dllama-router", description=__doc__)
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="HOST:PORT", dest="replicas",
+                   help="api_server replica address (repeat per replica)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9900)
+    p.add_argument("--routing", choices=("affinity", "random"),
+                   default="affinity",
+                   help="replica selection: 'affinity' prefers the replica "
+                        "whose recent routes share the longest prompt "
+                        "block-prefix (prefix-cache locality), least-loaded "
+                        "fallback; 'random' is the A/B control")
+    p.add_argument("--poll-interval", type=float, default=2.0, metavar="S",
+                   help="membership /healthz poll period")
+    p.add_argument("--poll-timeout", type=float, default=2.0, metavar="S")
+    p.add_argument("--block-bytes", type=int, default=64, metavar="B",
+                   help="affinity-map block granularity in prompt bytes "
+                        "(~ the replicas' --prefix-cache-block-tokens in "
+                        "bytes; smaller = finer matches, more trie nodes)")
+    p.add_argument("--affinity-nodes", type=int, default=8192, metavar="N",
+                   help="affinity trie capacity (LRU-evicted beyond N)")
+    p.add_argument("--retries", type=int, default=2, metavar="N",
+                   help="max failover tries on a DIFFERENT replica for "
+                        "requests that failed before their first byte")
+    p.add_argument("--proxy-timeout", type=float, default=120.0, metavar="S",
+                   help="per-try socket timeout (connect and each read)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="random-routing RNG seed (A/B reproducibility)")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    faults.install_from_env()  # DLLAMA_FAULTS chaos config (resilience/)
+    server = serve_router(
+        args.replicas, host=args.host, port=args.port, policy=args.routing,
+        poll_interval=args.poll_interval, poll_timeout=args.poll_timeout,
+        block_bytes=args.block_bytes, affinity_nodes=args.affinity_nodes,
+        retries=args.retries, try_timeout=args.proxy_timeout, seed=args.seed)
+
+    def _on_term(signum, frame):
+        # the router holds no request state worth draining beyond in-flight
+        # proxies; shutdown() lets those finish their handler threads
+        threading.Thread(target=close_router, args=(server,),
+                         name="router-drain", daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not the main thread
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        close_router(server)
+        print("🔴 router stopped")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,16 +9,33 @@ The cross-request layer between request admission and the device KV cache:
 
 BatchEngine integrates directly (runtime/batch_engine.py: admission seeding in
 `_assign`, harvest in `_finish`).
+
+Submodules are imported lazily (PEP 562): the fleet router (fleet/affinity.py)
+reuses the dependency-free radix trie from a process that deliberately loads
+no jax and registers no replica-tier metrics — an eager `from .block_pool
+import ...` here would drag quants/jax and the prefix_cache_* metric families
+into every `cache.radix` importer.
 """
 
-from .block_pool import KVBlockPool
-from .prefix_cache import PrefixCache, PrefixLease
-from .radix import RadixIndex
-from .single_slot import SingleSlotCache
+from __future__ import annotations
 
 __all__ = ["KVBlockPool", "PrefixCache", "PrefixLease", "RadixIndex",
            "SingleSlotCache", "default_pool_blocks", "make_prefix_cache",
            "warn_degraded"]
+
+_LAZY = {"KVBlockPool": "block_pool", "PrefixCache": "prefix_cache",
+         "PrefixLease": "prefix_cache", "RadixIndex": "radix",
+         "SingleSlotCache": "single_slot"}
+
+
+def __getattr__(name: str):
+    try:
+        mod = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+
+    return getattr(import_module(f".{mod}", __name__), name)
 
 
 def make_prefix_cache(cache_shape, itemsize: int, *, slots: int,
@@ -29,6 +46,8 @@ def make_prefix_cache(cache_shape, itemsize: int, *, slots: int,
     (BatchEngine and the single-slot ApiState): resolves the enable flag /
     passthrough-instance convention and the auto pool sizing, so the two
     surfaces cannot drift."""
+    from .prefix_cache import PrefixCache
+
     if not prefix_cache:
         return None
     if isinstance(prefix_cache, PrefixCache):

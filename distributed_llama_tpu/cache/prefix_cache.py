@@ -47,6 +47,10 @@ _UNUSED = metrics.counter(
 _HIT_TOKENS = metrics.counter(
     "prefix_cache_hit_tokens_total",
     "Prompt tokens served from cached KV blocks instead of prefill")
+_RESIDENT_TOKENS = metrics.counter(
+    "prefix_cache_resident_tokens_total",
+    "Prompt tokens covered by the slot's own resident rewind (reuse that "
+    "never touched the pool)")
 _EVICTED = metrics.counter(
     "prefix_cache_evicted_blocks_total", "Blocks LRU-evicted from the pool")
 _INSERTED = metrics.counter(
@@ -86,6 +90,7 @@ class PrefixCache:
         self.misses = 0
         self.unused_hits = 0
         self.hit_tokens = 0
+        self.resident_tokens = 0
         self.evicted_blocks = 0
         self.prompt_tokens = 0  # all prompt tokens seen by lookup()
 
@@ -172,6 +177,20 @@ class PrefixCache:
             self.hit_tokens += used_tokens
         _HITS.inc()
         _HIT_TOKENS.inc(used_tokens)
+
+    def note_resident(self, tokens: int) -> None:
+        """The engine's own slot rewind covered `tokens` leading prompt tokens
+        before the pool was even consulted. Counted separately from hit_tokens
+        (nothing was read from the pool) so reuse accounting doesn't depend on
+        WHICH mechanism skipped the prefill — the fleet bench sums both
+        (docs/FLEET.md): whether a sticky route lands on the slot that still
+        holds the prefix (rewind) or a sibling slot (radix seed) is a
+        scheduling accident, not a locality difference."""
+        if tokens <= 0:
+            return
+        with self._lock:
+            self.resident_tokens += tokens
+        _RESIDENT_TOKENS.inc(tokens)
 
     def mark_unused(self, lease: PrefixLease | None) -> None:
         """The caller discarded the lease without applying it (the slot/
@@ -301,9 +320,13 @@ class PrefixCache:
                 "hits": self.hits, "misses": self.misses,
                 "unused_hits": self.unused_hits,
                 "hit_tokens": self.hit_tokens,
+                "resident_tokens": self.resident_tokens,
                 "prompt_tokens": self.prompt_tokens,
                 "hit_rate": (self.hit_tokens / self.prompt_tokens
                              if self.prompt_tokens else 0.0),
+                "reuse_rate": ((self.hit_tokens + self.resident_tokens)
+                               / self.prompt_tokens
+                               if self.prompt_tokens else 0.0),
                 "lookup_hit_rate": ((self.hits + self.unused_hits) / looked
                                     if looked else 0.0),
                 "evicted_blocks": self.evicted_blocks,

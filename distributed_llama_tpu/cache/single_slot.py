@@ -55,6 +55,7 @@ class SingleSlotCache:
         eng = self.engine
         reuse = self._resident_common(prompt)
         if self.cache is not None:
+            self.cache.note_resident(reuse)
             cap = eng.spec.seq_len - 1
             lease = self.cache.lookup(prompt, cap=cap)
             if lease is not None and lease.tokens > reuse:

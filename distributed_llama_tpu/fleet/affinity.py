@@ -1,0 +1,73 @@
+"""Router-side prefix-affinity map: request prefix -> the replica that has it hot.
+
+The prefix cache (cache/, docs/PREFIX_CACHE.md) makes KV reuse *computable*
+inside one replica; this map makes it *routable* across a fleet. Every routed
+completion records (prompt-prefix, replica) here; a new request looks up the
+replica whose recent routes share the longest block-prefix with it and is sent
+there, so the shared prefix hits that replica's radix pool instead of being
+re-prefilled on whichever replica a load balancer happened to pick.
+
+Structure: the SAME block-granular radix trie as the replica-side cache index
+(cache/radix.py — reused, not reimplemented), with two differences of use:
+
+- keys are the raw UTF-8 bytes of the rendered messages, not token ids. The
+  router is deliberately tokenizer-free (it proxies for any model the replicas
+  load); byte-block prefix equality is a conservative proxy for token-block
+  prefix equality — two prompts sharing `block_bytes` leading bytes share
+  their leading token blocks for any deterministic tokenizer. `block_bytes`
+  should approximate the replica's `--prefix-cache-block-tokens` granularity
+  in bytes (default 64 bytes ~ 16 tokens x ~4 bytes/token).
+- node handles carry the id of the LAST replica routed through that prefix
+  (latest-wins) instead of block-pool handles; `refs` stay 0 so the LRU cap
+  can always evict.
+
+Bounded: `max_nodes` caps the trie; over-cap inserts evict LRU leaves via the
+radix index's own cascade. Thread-safe: one lock (handler threads race).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..cache.radix import RadixIndex
+
+__all__ = ["AffinityMap"]
+
+
+class AffinityMap:
+    def __init__(self, block_bytes: int = 64, max_nodes: int = 8192):
+        assert block_bytes >= 1 and max_nodes >= 1
+        self.block_bytes = block_bytes
+        self.max_nodes = max_nodes
+        self._radix = RadixIndex(block_tokens=block_bytes)
+        self._lock = threading.Lock()
+
+    def lookup(self, key: bytes, alive: set[str]) -> tuple[str | None, int]:
+        """(replica id, shared full blocks) for the deepest recorded route
+        whose replica is in `alive`; (None, 0) on no usable match.
+
+        Walking UP from the deepest matched node trades prefix depth for
+        availability: an ancestor's replica shares a shorter — but still
+        non-zero — prefix, which still beats a cold least-loaded pick."""
+        with self._lock:
+            nodes = self._radix.match(key)
+            for depth in range(len(nodes), 0, -1):
+                rep = nodes[depth - 1].handle
+                if rep in alive:
+                    return rep, depth
+        return None, 0
+
+    def record(self, key: bytes, replica: str) -> None:
+        """The request keyed by `key` was served by `replica`: stamp every
+        block of the prefix with it (latest-wins along the whole chain, so a
+        failover re-route redirects the prefix's future traffic too)."""
+        with self._lock:
+            chain = self._radix.insert(key, lambda _i: replica)
+            for node in chain:
+                node.handle = replica
+            if self._radix.nodes > self.max_nodes:
+                self._radix.evict(self._radix.nodes - self.max_nodes)
+
+    def nodes(self) -> int:
+        with self._lock:
+            return self._radix.nodes

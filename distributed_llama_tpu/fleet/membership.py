@@ -1,0 +1,214 @@
+"""Fleet membership: a static replica list kept live by a /healthz poller.
+
+The replica set is configuration (`--replica host:port`, repeated) — there is
+no discovery protocol — but *rotation* is dynamic: a background poller GETs
+every replica's `/healthz` (the identity/load block api_server publishes) on
+an interval and replicas leave rotation the moment they report `draining`
+(SIGTERM graceful drain, docs/ROBUSTNESS.md), report `unhealthy` (scheduler
+thread dead), or stop answering; they rejoin automatically on the first clean
+poll after recovery. The proxy path can also eject a replica synchronously
+(`mark_failed`) when a connect fails mid-request — rotation must not wait a
+poll interval to stop routing into a dead socket.
+
+The same poll carries the load block (free slots, queue depth) that feeds
+least-loaded routing, and the model-config hash that catches a replica
+serving a different model than the rest of the fleet (warned + counted, not
+fatal: the operator may be mid-rolling-upgrade).
+
+Polling is the `router.health` fault-injection point (resilience/faults.py):
+an injected error marks the replica unreachable for that round — the poller
+thread itself must survive anything a poll raises.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.client import HTTPConnection
+
+from ..obs import metrics
+from ..resilience import faults
+
+__all__ = ["Replica", "Membership"]
+
+_IN_ROTATION = metrics.gauge(
+    "router_replicas_in_rotation",
+    "Replicas currently healthy and not draining (routable)")
+_POLLS = metrics.counter(
+    "router_health_polls_total", "Membership /healthz polls by outcome",
+    labelnames=("outcome",))
+_HASH_MISMATCH = metrics.counter(
+    "router_model_hash_mismatch_total",
+    "Polls observing a replica whose model config hash differs from the fleet's")
+
+
+@dataclass
+class Replica:
+    """One api_server behind the router. Health/load fields are the last
+    poll's reading; `inflight` is the router's own live proxy count."""
+
+    host: str
+    port: int
+    id: str = ""
+    healthy: bool = False
+    draining: bool = False
+    status: str = "unpolled"   # ok | draining | unhealthy | unreachable | unpolled
+    model_hash: str | None = None
+    slots: int = 0
+    free_slots: int = 0
+    queue_depth: int = 0
+    inflight: int = 0
+    consecutive_failures: int = 0
+    last_ok: float = 0.0
+    hash_warned: bool = False  # rate-limits the model-mismatch warning
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def __post_init__(self):
+        if not self.id:
+            self.id = f"{self.host}:{self.port}"
+
+    def load_score(self) -> tuple:
+        """Least-loaded ordering: fewest waiting+in-flight first, then most
+        free slots, then id for determinism."""
+        return (self.queue_depth + self.inflight, -self.free_slots, self.id)
+
+    def snapshot(self) -> dict:
+        return {"id": self.id, "healthy": self.healthy,
+                "draining": self.draining, "status": self.status,
+                "model_hash": self.model_hash, "slots": self.slots,
+                "free_slots": self.free_slots,
+                "queue_depth": self.queue_depth, "inflight": self.inflight}
+
+
+def parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"bad replica address {addr!r} (want host:port)")
+    return host, int(port)
+
+
+class Membership:
+    def __init__(self, addrs: list[str], poll_interval: float = 2.0,
+                 poll_timeout: float = 2.0):
+        if not addrs:
+            raise ValueError("router needs at least one --replica host:port")
+        self.replicas = [Replica(*parse_addr(a)) for a in addrs]
+        if len({r.id for r in self.replicas}) != len(self.replicas):
+            raise ValueError("duplicate replica addresses")
+        self.poll_interval = poll_interval
+        self.poll_timeout = poll_timeout
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._fleet_hash: str | None = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        """Synchronous first poll (the router can route immediately after
+        start() returns), then the background refresh loop."""
+        self.poll_once()
+        self._thread = threading.Thread(target=self._run, name="fleet-poll",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_timeout + 1.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            self.poll_once()
+
+    # ------------------------------------------------------------------
+    # polling
+    # ------------------------------------------------------------------
+
+    def poll_once(self) -> None:
+        for rep in self.replicas:
+            self._poll(rep)
+        _IN_ROTATION.set(len(self.in_rotation()))
+
+    def _poll(self, rep: Replica) -> None:
+        try:
+            faults.fire("router.health", replica=rep.id)
+            conn = HTTPConnection(rep.host, rep.port,
+                                  timeout=self.poll_timeout)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                body = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+        except Exception:
+            rep.healthy = False
+            rep.draining = False
+            rep.status = "unreachable"
+            rep.consecutive_failures += 1
+            _POLLS.labels(outcome="unreachable").inc()
+            return
+        status = body.get("status",
+                          "ok" if resp.status == 200 else "unhealthy")
+        block = body.get("replica") or {}
+        rep.status = status
+        rep.healthy = resp.status == 200 and status == "ok"
+        rep.draining = status == "draining" or bool(block.get("draining"))
+        rep.slots = int(block.get("slots", rep.slots) or 0)
+        rep.free_slots = int(block.get("free_slots", rep.free_slots) or 0)
+        rep.queue_depth = int(block.get("queue_depth", rep.queue_depth) or 0)
+        rep.model_hash = block.get("model_hash", rep.model_hash)
+        if rep.healthy:
+            rep.consecutive_failures = 0
+            rep.last_ok = time.monotonic()
+            if rep.model_hash:
+                if self._fleet_hash is None:
+                    self._fleet_hash = rep.model_hash
+                elif rep.model_hash != self._fleet_hash:
+                    _HASH_MISMATCH.inc()
+                    if not rep.hash_warned:  # once per mismatch episode
+                        rep.hash_warned = True
+                        print(f"⚠️  replica {rep.id} serves model hash "
+                              f"{rep.model_hash}, fleet is "
+                              f"{self._fleet_hash} — mid-rolling-upgrade or "
+                              "a misdeployed checkpoint")
+                else:
+                    rep.hash_warned = False
+        _POLLS.labels(outcome=status).inc()
+
+    # ------------------------------------------------------------------
+    # rotation / selection
+    # ------------------------------------------------------------------
+
+    def in_rotation(self) -> list[Replica]:
+        return [r for r in self.replicas if r.healthy and not r.draining]
+
+    def by_id(self, rep_id: str) -> Replica | None:
+        for r in self.replicas:
+            if r.id == rep_id:
+                return r
+        return None
+
+    def mark_failed(self, rep: Replica) -> None:
+        """Proxy-path ejection: a connect/read failure takes the replica out
+        of rotation NOW; the poller re-admits it on the next clean poll."""
+        rep.healthy = False
+        rep.status = "unreachable"
+        rep.consecutive_failures += 1
+        _IN_ROTATION.set(len(self.in_rotation()))
+
+    def least_loaded(self, exclude: set[str] = frozenset()
+                     ) -> Replica | None:
+        cands = [r for r in self.in_rotation() if r.id not in exclude]
+        return min(cands, key=Replica.load_score) if cands else None
+
+    def inflight_inc(self, rep: Replica) -> None:
+        with rep._lock:
+            rep.inflight += 1
+
+    def inflight_dec(self, rep: Replica) -> None:
+        with rep._lock:
+            rep.inflight = max(rep.inflight - 1, 0)

@@ -1,0 +1,543 @@
+"""Fleet router: cache-affinity HTTP front for N api_server replicas.
+
+One dependency-free process (stdlib http only, same discipline as
+apps/api_server.py) that turns the single-replica serving stack into a
+horizontal fleet:
+
+- **routing** — `pick()` prefers the replica whose recent routes share the
+  longest byte-block prefix with the request (fleet/affinity.py over the
+  cache/radix.py trie), so shared system prompts hit the replica whose
+  prefix cache already holds their KV; misses fall back to least-loaded by
+  the polled queue-depth/free-slot load block plus the router's own
+  in-flight counts. `policy="random"` is the A/B control
+  (`bench.py --routing random`).
+- **proxying** — streaming SSE and non-streaming bodies pass through
+  verbatim with a per-try socket timeout. A try that fails BEFORE the first
+  byte reaches the client (connect error, injected `router.proxy` fault,
+  replica 503) retries on a different replica — completions are idempotent
+  until output is delivered — bounded by `retries`; once bytes have flowed
+  the failure is surfaced as an SSE error event, never a silent re-issue.
+  When every candidate is exhausted or the rotation is empty the client
+  gets 503 + Retry-After (the fleet-level analog of the replica's
+  admission-control shed).
+- **observability** — `GET /metrics` merges every replica's Prometheus
+  exposition under a `replica="host:port"` label with the router's own
+  counters (routes by reason, proxy errors, per-replica inflight);
+  `GET /v1/stats` serves the JSON equivalent; `GET /healthz` reports
+  rotation so the router itself can sit behind a dumb L4 balancer.
+
+Topology/flags: docs/FLEET.md. Entry point: apps/router.py.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..obs import metrics
+from ..resilience import faults
+from .affinity import AffinityMap
+from .membership import Membership, Replica
+
+__all__ = ["RouterState", "serve_router", "close_router", "merge_prometheus"]
+
+_ROUTES = metrics.counter(
+    "router_routes_total",
+    "Requests routed, by decision reason (docs/FLEET.md)",
+    labelnames=("reason",))
+_PROXY_ERRORS = metrics.counter(
+    "router_proxy_errors_total", "Proxy-path failures by kind",
+    labelnames=("kind",))
+_INFLIGHT = metrics.gauge(
+    "router_replica_inflight", "Router-side in-flight proxies per replica",
+    labelnames=("replica",))
+_HTTP = metrics.counter(
+    "router_http_requests_total", "Router HTTP responses by route and code",
+    labelnames=("route", "code"))
+_RETRIES = metrics.counter(
+    "router_retried_requests_total",
+    "Requests that needed at least one failover try")
+_SCRAPE_ERRORS = metrics.counter(
+    "router_scrape_errors_total",
+    "Replica /metrics//v1/stats fetches that failed during aggregation")
+_PROXY_SECONDS = metrics.histogram(
+    "router_proxy_seconds", "Per-try proxy wall time (successful tries)")
+
+_KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
+                 "/v1/stats", "/metrics", "/health", "/healthz")
+
+
+class RouterState:
+    def __init__(self, membership: Membership, policy: str = "affinity",
+                 block_bytes: int = 64, affinity_nodes: int = 8192,
+                 retries: int = 2, try_timeout: float = 120.0,
+                 scrape_timeout: float = 3.0, key_bytes: int = 4096,
+                 seed: int = 0):
+        assert policy in ("affinity", "random"), policy
+        self.membership = membership
+        self.affinity = AffinityMap(block_bytes=block_bytes,
+                                    max_nodes=affinity_nodes)
+        self.policy = policy
+        self.retries = max(retries, 0)
+        self.try_timeout = try_timeout
+        self.scrape_timeout = scrape_timeout
+        self.key_bytes = key_bytes
+        self._rng = random.Random(seed)
+        self._rr = 0  # round-robin clock for least-loaded ties
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # routing decision
+    # ------------------------------------------------------------------
+
+    def affinity_key(self, body: dict) -> bytes:
+        """Deterministic byte key of the prompt prefix: the messages in
+        render order, role and content separated by sentinels so
+        ("ab","c") cannot collide with ("a","bc"). Capped — affinity only
+        needs the leading blocks, not the whole conversation."""
+        parts = []
+        for m in body.get("messages", []):
+            if not isinstance(m, dict):
+                continue
+            parts.append(str(m.get("role", "user")).encode("utf-8", "replace")
+                         + b"\x00"
+                         + str(m.get("content", "")).encode("utf-8", "replace")
+                         + b"\x1e")
+            if sum(len(p) for p in parts) >= self.key_bytes:
+                break
+        return b"".join(parts)[:self.key_bytes]
+
+    def pick(self, key: bytes, tried: set[str]) -> tuple[Replica | None, str]:
+        """(replica, reason) for the next try; (None, "saturated") when no
+        routable replica remains. Reasons: affinity | least_loaded | random
+        on the first try, failover afterwards."""
+        rotation = [r for r in self.membership.in_rotation()
+                    if r.id not in tried]
+        if not rotation:
+            return None, "saturated"
+        if tried:
+            return min(rotation, key=Replica.load_score), "failover"
+        if self.policy == "random":
+            with self._lock:
+                return self._rng.choice(rotation), "random"
+        rep_id, _depth = self.affinity.lookup(key, {r.id for r in rotation})
+        if rep_id is not None:
+            return self.membership.by_id(rep_id), "affinity"
+        # cold prefix: least-loaded, with ROUND-ROBIN among load ties — a
+        # fixed tie-break (e.g. lowest id) would send every cold prefix of a
+        # quiet fleet to one replica, and affinity would then pin all their
+        # future traffic there too (observed: one replica served ~everything
+        # until the fleet warmed unevenly into saturation)
+        load = lambda r: (r.queue_depth + r.inflight, -r.free_slots)  # noqa: E731
+        best = min(load(r) for r in rotation)
+        ties = [r for r in rotation if load(r) == best]
+        with self._lock:
+            pick = ties[self._rr % len(ties)]
+            self._rr += 1
+        return pick, "least_loaded"
+
+
+# ----------------------------------------------------------------------
+# Prometheus merge
+# ----------------------------------------------------------------------
+
+def _inject_label(sample: str, label: str) -> str:
+    """Add `label` (e.g. replica="h:p") to one exposition sample line."""
+    brace = sample.find("{")
+    space = sample.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        return sample[:brace + 1] + label + "," + sample[brace + 1:]
+    return sample[:space] + "{" + label + "}" + sample[space:]
+
+
+def merge_prometheus(texts: list[tuple[str | None, str]]) -> str:
+    """Merge expositions into one: `texts` is [(replica id or None, text)].
+    Samples from labeled sources get `replica="<id>"` injected; HELP/TYPE
+    headers are emitted once per family (first source wins). Families are
+    attributed by the running header like our own renderer emits them, with
+    a name-prefix fallback for any foreign layout."""
+    families: dict[str, dict] = {}
+    order: list[str] = []
+
+    def fam_for(name: str) -> dict:
+        if name not in families:
+            families[name] = {"help": None, "type": None, "samples": []}
+            order.append(name)
+        return families[name]
+
+    for rep_id, text in texts:
+        label = f'replica="{rep_id}"' if rep_id is not None else None
+        current: str | None = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                kind = "help" if line[2] == "H" else "type"
+                rest = line[7:].split(" ", 1)
+                current = rest[0]
+                fam = fam_for(current)
+                if fam[kind] is None:
+                    fam[kind] = rest[1] if len(rest) > 1 else ""
+                continue
+            if line.startswith("#"):
+                continue
+            mname = line.split("{", 1)[0].split(" ", 1)[0]
+            name = (current if current is not None and mname.startswith(current)
+                    else mname)
+            fam_for(name)["samples"].append(
+                _inject_label(line, label) if label else line)
+    out = []
+    for name in order:
+        fam = families[name]
+        if fam["help"] is not None:
+            out.append(f"# HELP {name} {fam['help']}")
+        if fam["type"] is not None:
+            out.append(f"# TYPE {name} {fam['type']}")
+        out.extend(fam["samples"])
+    return "\n".join(out) + "\n"
+
+
+def _fetch(rep: Replica, path: str, timeout: float) -> tuple[int, bytes]:
+    conn = HTTPConnection(rep.host, rep.port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _scrape_all(state: RouterState, path: str) -> list[tuple[Replica, object]]:
+    """Fetch `path` from every replica CONCURRENTLY (one thread each, joined
+    at scrape_timeout): a serial loop would block an aggregation request up
+    to scrape_timeout PER unreachable replica — exactly during the rolling
+    restarts and incidents monitoring exists for. Returns (replica, result)
+    pairs where result is (status, body) or the raised exception."""
+    results: list = [None] * len(state.membership.replicas)
+
+    def fetch(i: int, rep: Replica) -> None:
+        try:
+            results[i] = _fetch(rep, path, state.scrape_timeout)
+        except Exception as e:
+            results[i] = e
+
+    threads = [threading.Thread(target=fetch, args=(i, rep), daemon=True)
+               for i, rep in enumerate(state.membership.replicas)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + state.scrape_timeout + 1.0
+    for t in threads:
+        t.join(timeout=max(deadline - time.monotonic(), 0.0))
+    out = []
+    for rep, res in zip(state.membership.replicas, results):
+        out.append((rep, res if res is not None
+                    else TimeoutError("scrape timed out")))
+    return out
+
+
+def fleet_metrics(state: RouterState) -> str:
+    """Router-own exposition + every reachable replica's, replica-labeled."""
+    texts: list[tuple[str | None, str]] = [(None, metrics.render())]
+    for rep, res in _scrape_all(state, "/metrics"):
+        if isinstance(res, tuple) and res[0] == 200:
+            texts.append((rep.id, res[1].decode("utf-8", "replace")))
+        else:
+            _SCRAPE_ERRORS.inc()
+    return merge_prometheus(texts)
+
+
+def fleet_stats(state: RouterState) -> dict:
+    out = {
+        "time": int(time.time()),
+        "router": {
+            "policy": state.policy,
+            "affinity_nodes": state.affinity.nodes(),
+            "replicas": {r.id: r.snapshot()
+                         for r in state.membership.replicas},
+            "metrics": metrics.snapshot(),
+        },
+        "replicas": {},
+    }
+    for rep, res in _scrape_all(state, "/v1/stats"):
+        if isinstance(res, tuple):
+            status, body = res
+            try:
+                # a 200 with a non-JSON body (wrong process on the port, an
+                # LB error page) must degrade to THIS replica's error entry,
+                # not crash the whole aggregation
+                out["replicas"][rep.id] = (json.loads(body) if status == 200
+                                           else {"error": f"status {status}"})
+            except ValueError as e:
+                _SCRAPE_ERRORS.inc()
+                out["replicas"][rep.id] = {"error": f"non-JSON body: {e}"}
+        else:
+            _SCRAPE_ERRORS.inc()
+            out["replicas"][rep.id] = {"error": repr(res)}
+    return out
+
+
+# ----------------------------------------------------------------------
+# HTTP handler
+# ----------------------------------------------------------------------
+
+class RouterHandler(BaseHTTPRequestHandler):
+    state: RouterState  # injected by serve_router
+
+    def log_message(self, fmt, *args):
+        print(f"🔶 {self.command} {self.path}")
+
+    def _count(self, code: int) -> None:
+        route = self.path if self.path in _KNOWN_ROUTES else "other"
+        _HTTP.labels(route=route, code=str(code)).inc()
+
+    def _raw(self, code: int, content_type: str, data: bytes,
+             extra_headers: dict | None = None):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+        self._count(code)
+
+    def _json(self, code: int, payload: dict,
+              extra_headers: dict | None = None):
+        self._raw(code, "application/json", json.dumps(payload).encode(),
+                  extra_headers)
+
+    def _error(self, code: int, message: str, etype: str,
+               retry_after: float | None = None):
+        hdrs = ({"Retry-After": str(max(int(retry_after + 0.5), 1))}
+                if retry_after is not None else None)
+        self._json(code, {"error": {"message": message, "type": etype}}, hdrs)
+
+    # -------------------------------------------------------------- GET
+
+    def do_GET(self):
+        state = self.state
+        if self.path in ("/health", "/healthz"):
+            rotation = state.membership.in_rotation()
+            payload = {
+                "status": "ok" if rotation else "no_healthy_replicas",
+                "role": "router",
+                "in_rotation": len(rotation),
+                "replicas": {r.id: r.snapshot()
+                             for r in state.membership.replicas},
+            }
+            self._json(200 if rotation else 503, payload)
+        elif self.path == "/metrics":
+            self._raw(200, "text/plain; version=0.0.4; charset=utf-8",
+                      fleet_metrics(state).encode())
+        elif self.path == "/v1/stats":
+            self._json(200, fleet_stats(state))
+        elif self.path == "/v1/models":
+            rep = state.membership.least_loaded()
+            if rep is None:
+                self._error(503, "no healthy replica", "overloaded_error",
+                            retry_after=state.membership.poll_interval)
+                return
+            try:
+                status, body = _fetch(rep, self.path, state.try_timeout)
+                self._raw(status, "application/json", body)
+            except Exception as e:
+                self._error(502, f"replica {rep.id} unreachable: {e}",
+                            "server_error")
+        else:
+            self._error(404, f"Unknown route: {self.path}",
+                        "invalid_request_error")
+
+    # ------------------------------------------------------------- POST
+
+    def do_POST(self):
+        if self.path not in ("/v1/chat/completions", "/chat/completions"):
+            self._error(404, f"Unknown route: {self.path}",
+                        "invalid_request_error")
+            return
+        state = self.state
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) or b"{}"
+            body = json.loads(raw)
+            if not isinstance(body, dict):
+                raise ValueError("body is not an object")
+        except (ValueError, json.JSONDecodeError):
+            self._error(400, "Request body is not valid JSON",
+                        "invalid_request_error")
+            return
+        key = state.affinity_key(body)
+        tried: set[str] = set()
+        last_503: tuple[bytes, str, str | None] | None = None
+        for attempt in range(1 + state.retries):
+            rep, reason = state.pick(key, tried)
+            if rep is None:
+                break
+            tried.add(rep.id)
+            _ROUTES.labels(reason=reason).inc()
+            if attempt == 1:
+                _RETRIES.inc()
+            outcome, info = self._proxy_try(rep, raw, key)
+            if outcome == "delivered" or outcome == "aborted":
+                return
+            if info is not None:  # a relayable 503 from this replica
+                last_503 = info
+        # every candidate exhausted (or rotation empty): fleet-level shed.
+        # A replica's own 503 body is the most honest thing to relay; either
+        # way the client ALWAYS gets Retry-After so it backs off instead of
+        # hammering a saturated fleet (docs/FLEET.md).
+        retry_after = state.membership.poll_interval
+        if last_503 is not None:
+            data, ctype, ra = last_503
+            self._raw(503, ctype, data,
+                      {"Retry-After": ra or str(max(int(retry_after), 1))})
+        else:
+            self._error(503, "no replica available "
+                        f"({len(tried)} tried, "
+                        f"{len(state.membership.in_rotation())} in rotation)",
+                        "overloaded_error", retry_after=retry_after)
+
+    # ------------------------------------------------------------ proxy
+
+    def _proxy_try(self, rep: Replica, raw: bytes, key: bytes):
+        """One proxy attempt against `rep`. Returns (outcome, relayable):
+        outcome "delivered" (response fully relayed), "aborted" (failed
+        after client bytes — already terminated, never retry), or "retry"
+        (nothing reached the client; relayable = (body, ctype, retry_after)
+        when the failure was a replica 503 worth relaying)."""
+        state = self.state
+        mem = state.membership
+        mem.inflight_inc(rep)
+        _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+        conn = None
+        t0 = time.perf_counter()
+        try:
+            try:
+                faults.fire("router.proxy", replica=rep.id)
+                conn = HTTPConnection(rep.host, rep.port,
+                                      timeout=state.try_timeout)
+                conn.request("POST", self.path, raw,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except Exception:
+                _PROXY_ERRORS.labels(kind="connect").inc()
+                mem.mark_failed(rep)
+                return "retry", None
+            if resp.status == 503:
+                # shed (overloaded, Retry-After) or drain — in both cases
+                # another replica may serve this request right now. Reflect
+                # a drain in membership immediately; the poller confirms.
+                data = resp.read()
+                _PROXY_ERRORS.labels(kind="status_503").inc()
+                if b"server_shutting_down" in data or b"draining" in data:
+                    rep.draining = True
+                return "retry", (data,
+                                 resp.getheader("Content-Type",
+                                                "application/json"),
+                                 resp.getheader("Retry-After"))
+            ctype = resp.getheader("Content-Type", "application/json")
+            if "text/event-stream" in ctype:
+                return self._relay_stream(rep, resp, key)
+            # non-streaming (includes pre-stream errors with real status
+            # codes — api_server defers SSE headers to the first delta, so a
+            # 400/408 arrives here as plain JSON): relay verbatim, no retry
+            # of non-503 errors (they are deterministic caller errors).
+            data = resp.read()
+            self._raw(resp.status, ctype, data)
+            if resp.status == 200:
+                state.affinity.record(key, rep.id)
+                _PROXY_SECONDS.observe(time.perf_counter() - t0)
+            return "delivered", None
+        finally:
+            if conn is not None:
+                conn.close()
+            mem.inflight_dec(rep)
+            _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+
+    def _relay_stream(self, rep: Replica, resp, key: bytes):
+        """SSE pass-through. Client headers are deferred to the first
+        upstream byte so an upstream that dies before producing anything is
+        still retryable on another replica."""
+        state = self.state
+        sent_any = False
+        t0 = time.perf_counter()
+        while True:
+            try:
+                chunk = resp.read1(65536)
+            except Exception:
+                _PROXY_ERRORS.labels(kind="read").inc()
+                if not sent_any:
+                    state.membership.mark_failed(rep)
+                    return "retry", None
+                # mid-stream: the client already has partial output — a
+                # retry would double-deliver. Honest termination instead.
+                self._write_chunk(
+                    ("data: " + json.dumps({"error": {
+                        "message": f"upstream replica {rep.id} failed "
+                                   "mid-stream", "type": "server_error"}})
+                     + "\n\n").encode())
+                self._write_chunk(b"data: [DONE]\n\n")
+                self._write_chunk(b"")
+                return "aborted", None
+            if not chunk:
+                break
+            if not sent_any:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                self._count(200)
+                sent_any = True
+            self._write_chunk(chunk)
+        if not sent_any:
+            # 200 event-stream with an empty body is a malformed upstream;
+            # nothing reached the client, so another replica may try
+            _PROXY_ERRORS.labels(kind="empty_stream").inc()
+            return "retry", None
+        self._write_chunk(b"")  # terminate the chunked response
+        state.affinity.record(key, rep.id)
+        _PROXY_SECONDS.observe(time.perf_counter() - t0)
+        return "delivered", None
+
+    def _write_chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+
+# ----------------------------------------------------------------------
+# server plumbing
+# ----------------------------------------------------------------------
+
+def serve_router(replicas: list[str], host: str = "0.0.0.0",
+                 port: int = 9900, policy: str = "affinity",
+                 poll_interval: float = 2.0, poll_timeout: float = 2.0,
+                 block_bytes: int = 64, affinity_nodes: int = 8192,
+                 retries: int = 2, try_timeout: float = 120.0,
+                 seed: int = 0) -> ThreadingHTTPServer:
+    """Build + bind the router (does NOT serve_forever — caller's thread
+    choice). Membership is polled once synchronously so the first request
+    already has a rotation. `server.router_state` exposes the state."""
+    membership = Membership(replicas, poll_interval=poll_interval,
+                            poll_timeout=poll_timeout)
+    state = RouterState(membership, policy=policy, block_bytes=block_bytes,
+                        affinity_nodes=affinity_nodes, retries=retries,
+                        try_timeout=try_timeout, seed=seed)
+    membership.start()
+    handler = type("BoundRouterHandler", (RouterHandler,),
+                   {"state": state, "protocol_version": "HTTP/1.1"})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.router_state = state
+    print(f"🟢 fleet router listening on {host}:{server.server_address[1]} "
+          f"({len(membership.replicas)} replicas, policy={policy})")
+    return server
+
+
+def close_router(server: ThreadingHTTPServer) -> None:
+    """Stop serving and the membership poller (idempotent)."""
+    server.shutdown()
+    server.server_close()
+    server.router_state.membership.stop()

@@ -2,12 +2,29 @@
 
 The reference compresses every inter-node activation transfer to Q80 (F32->int8+f16
 scale) before the TCP write and dequantizes after (src/tasks.cpp:96-135), cutting wire
-bytes ~3.8x (README.md:135-147). On TPU the analog is quantizing the *collective* payload:
-`quantized_psum` sends int8 values + f16 scales through an all_gather and sums locally.
+bytes ~3.8x (README.md:135-147). On TPU the analog is quantizing the *collective*
+payload.
 
-On ICI this is usually a wash (bf16 psum is fast); across DCN-connected slices the 2-4x
-payload cut matters — same tradeoff the EQuARX paper makes inside XLA. Off by default;
-measured, not assumed (SURVEY.md §7).
+`quantized_psum` is the EQuARX-style two-phase compressed all-reduce:
+
+1. **scatter-reduce** — quantize the local partial, `all_to_all` the quantized
+   shards so device d holds every peer's copy of shard d ((n-1)/n of the
+   compressed payload on the wire), dequantize and sum locally;
+2. **gather** — re-quantize the reduced shard and `all_gather` it back to the
+   full vector ((n-1)/n of the compressed payload again).
+
+Total per-device wire bytes: 2*(n-1)/n x (34/32 bytes/elem) — the same ring
+all-reduce factor as the fp path at ~3.8x less payload, and exactly what
+`runtime/engine.py collective_kbytes_per_token(compress=True)` models (the
+estimate is pinned against the measured jaxpr accounting in
+tests/test_engine.py). The earlier single-phase form — all_gather the FULL
+quantized payload and sum locally — shipped n_dev/2 x more bytes than the
+model claimed; it survives as `quantized_psum_gather`, used only when the
+Q80 block count doesn't divide the axis size.
+
+On ICI this is usually a wash (bf16 psum is fast); across DCN-connected slices the
+2-4x payload cut matters — same tradeoff the EQuARX paper makes inside XLA. Off by
+default; measured, not assumed (SURVEY.md §7).
 """
 
 from __future__ import annotations
@@ -15,17 +32,60 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..quants import jnp_dequantize_q80, jnp_quantize_q80
+from ..quants import QK, jnp_dequantize_q80, jnp_quantize_q80
 
 
-def quantized_psum(x: jax.Array, axis_name: str) -> jax.Array:
-    """All-reduce with Q80-compressed payload. x: (..., n), n % 32 == 0."""
+def quantized_psum_gather(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-reduce with Q80 payload, single-phase: all_gather the full
+    quantized tensor and sum locally. Wire bytes (n-1)/n x n x payload —
+    n/2 x the two-phase form — kept as the fallback for shapes whose block
+    count doesn't split across the axis. x: (..., n), n % 32 == 0."""
     orig_dtype = x.dtype
     vals, scales = jnp_quantize_q80(x)
     vals_g = jax.lax.all_gather(vals, axis_name)      # (n_dev, ..., nb, 32) int8
     scales_g = jax.lax.all_gather(scales, axis_name)  # (n_dev, ..., nb) f16
     deq = jnp_dequantize_q80(vals_g, scales_g, dtype=jnp.float32)
     return jnp.sum(deq, axis=0).reshape(x.shape).astype(orig_dtype)
+
+
+def quantized_psum(x: jax.Array, axis_name: str) -> jax.Array:
+    """Two-phase Q80-compressed all-reduce (module docstring). x: (..., n),
+    n % 32 == 0. Numerics: two quantization rounds (partials, then the
+    reduced shard) instead of one — still well within the wire-compression
+    error budget (tests/test_tp.py::test_compressed_collectives)."""
+    n_dev = jax.lax.psum(1, axis_name)  # static: the axis size
+    if n_dev <= 1:
+        return x
+    orig_dtype = x.dtype
+    orig_shape = x.shape
+    vals, scales = jnp_quantize_q80(x)  # (..., nb, 32) int8, (..., nb) f16
+    nb = vals.shape[-2]
+    if nb % n_dev != 0:
+        return quantized_psum_gather(x, axis_name)
+    # phase 1: scatter-reduce. Split the block axis into n_dev chunks;
+    # all_to_all leaves device d holding every source's chunk d (the
+    # inserted axis indexes the source device), dequantize + sum = this
+    # device's shard of the reduced result.
+    vals = vals.reshape(*vals.shape[:-2], n_dev, nb // n_dev, QK)
+    scales = scales.reshape(*scales.shape[:-1], n_dev, nb // n_dev)
+    vax, sax = vals.ndim - 3, scales.ndim - 2  # the n_dev chunk axes
+    vals_t = jax.lax.all_to_all(vals, axis_name, split_axis=vax,
+                                concat_axis=vax)
+    scales_t = jax.lax.all_to_all(scales, axis_name, split_axis=sax,
+                                  concat_axis=sax)
+    # dequant collapses (chunk_blocks, 32) -> chunk elems; source axis at -2
+    shard = jnp.sum(jnp_dequantize_q80(vals_t, scales_t, dtype=jnp.float32),
+                    axis=-2)
+    # phase 2: gather. Re-quantize the reduced shard and reassemble the full
+    # vector; chunk index == device index, so tiled concatenation in device
+    # order restores block order.
+    rvals, rscales = jnp_quantize_q80(shard)  # (..., nb/n, 32), (..., nb/n)
+    vals_g = jax.lax.all_gather(rvals, axis_name, axis=rvals.ndim - 2,
+                                tiled=True)
+    scales_g = jax.lax.all_gather(rscales, axis_name,
+                                  axis=rscales.ndim - 1, tiled=True)
+    out = jnp_dequantize_q80(vals_g, scales_g, dtype=jnp.float32)
+    return out.reshape(orig_shape).astype(orig_dtype)
 
 
 def psum(x: jax.Array, axis_name: str, compress: bool = False) -> jax.Array:

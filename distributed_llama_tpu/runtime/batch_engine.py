@@ -426,6 +426,18 @@ class BatchEngine:
         t = self._thread
         return t is None or t.is_alive()
 
+    def load_stats(self) -> dict:
+        """Slot/queue load reading for the /healthz replica block a fleet
+        router's least-loaded routing consumes (fleet/membership.py):
+        `free_slots` = slots with no request bound, `queue_depth` = requests
+        waiting for one (admitted-pending + submit queue)."""
+        with self._plock:
+            occupied = sum(1 for s in self._slots if s.req is not None)
+            queued = len(self._pending) + self._queue.qsize()
+        return {"slots": self.slots_n,
+                "free_slots": self.slots_n - occupied,
+                "queue_depth": queued}
+
     def _dispatch_age(self) -> float:
         """Watchdog reading: 0 while nothing is in flight (an idle scheduler
         is not a hung one); otherwise seconds since the scheduler last made
@@ -524,6 +536,9 @@ class BatchEngine:
         best = max(free, key=common)
         reuse = common(best)
         if self.prefix_cache is not None:
+            # [0, reuse) is served by the slot's own resident rows; anything
+            # the radix seed adds on top is counted as hit_tokens inside
+            self.prefix_cache.note_resident(reuse)
             reuse = self._seed_from_cache(best, req, reuse)
         best.admit_t = time.monotonic()  # before .req: the watchdog keys on req
         best.req = req

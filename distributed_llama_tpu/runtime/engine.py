@@ -110,16 +110,27 @@ class GenerationStats:
 
 
 def collective_kbytes_per_token(spec: ModelSpec, tp: int, compress: bool) -> float:
-    """Bytes each device exchanges per decoded token (all-reduce modeled as 2x(tp-1)/tp
-    of payload out + in). Mirrors the reference's S/R socket counters, which measured the
-    root's broadcast+gather per layer (tasks.cpp:44-94)."""
+    """Bytes each device exchanges per decoded token. Mirrors the reference's
+    S/R socket counters (root broadcast+gather per layer, tasks.cpp:44-94)
+    with ring-collective wire costs:
+
+    - per layer, two activation all-reduces (attention-out + ffn-out), each
+      2x(tp-1)/tp of its payload. Compressed, the payload is the Q80 wire
+      format (int8 vals + f16 scale per 32-block = 34/32 bytes/elem) moved by
+      the two-phase quantized reduce in parallel/collectives.py — all_to_all
+      then all_gather, each (tp-1)/tp of the compressed payload, so the SAME
+      2x(tp-1)/tp factor holds and this estimate is true of the real program
+      (the old single-phase all_gather form shipped tp/2 x more than claimed;
+      estimate-vs-measured is pinned in tests/test_engine.py);
+    - one logits all-gather: each device contributes its vocab/tp slice and
+      receives the rest, (tp-1)/tp of the full f32 logits row."""
     if tp <= 1:
         return 0.0
-    elem = 34 / 32 if compress else 4  # Q80 bytes/elem vs f32
+    elem = 34 / 32 if compress else 4  # Q80 wire bytes/elem vs f32
     per_layer = 2 * spec.dim * elem  # attention-out psum + ffn-out psum payloads
-    logits = (spec.vocab_size // tp) * 4
-    payload = spec.n_layers * per_layer + logits
-    return 2 * (tp - 1) / tp * payload / 1024.0
+    layers = 2 * (tp - 1) / tp * spec.n_layers * per_layer
+    logits = (tp - 1) / tp * spec.vocab_size * 4
+    return (layers + logits) / 1024.0
 
 
 class Engine:

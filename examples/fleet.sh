@@ -1,0 +1,82 @@
+#!/bin/bash
+# Fleet-tier demo (docs/FLEET.md): two api_server replicas fronted by the
+# prefix-affinity router. Clients share one long system prompt and talk ONLY
+# to the router; affinity routing keeps the shared prefix's traffic sticky to
+# the replica whose radix cache already holds its KV — watch the per-replica
+# prefix-reuse counters and the router's routes-by-reason split at the end.
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL="${DLLAMA_MODEL:-/tmp/dlt_determinism/tiny.m}"
+TOKENIZER="${DLLAMA_TOKENIZER:-/tmp/dlt_determinism/tiny.t}"
+if [ ! -f "$MODEL" ]; then
+  mkdir -p /tmp/dlt_determinism
+  python examples/make_tiny_model.py /tmp/dlt_determinism
+fi
+
+export JAX_PLATFORMS=cpu
+PORT_A="${PORT_A:-9994}"
+PORT_B="${PORT_B:-9995}"
+ROUTER_PORT="${ROUTER_PORT:-9996}"
+
+LOGDIR="$(mktemp -d /tmp/dlt_fleet_demo.XXXXXX)"
+for PORT in "$PORT_A" "$PORT_B"; do
+  python -m distributed_llama_tpu.apps.api_server \
+    --model "$MODEL" --tokenizer "$TOKENIZER" --chat-template chatml \
+    --host 127.0.0.1 --port "$PORT" --batch 2 --superstep 4 \
+    --prefix-cache-block-tokens 8 >"$LOGDIR/replica_$PORT.log" 2>&1 &
+done
+python -m distributed_llama_tpu.apps.router \
+  --replica "127.0.0.1:$PORT_A" --replica "127.0.0.1:$PORT_B" \
+  --host 127.0.0.1 --port "$ROUTER_PORT" --poll-interval 0.5 \
+  --block-bytes 32 >"$LOGDIR/router.log" 2>&1 &
+SERVER_PIDS="$(jobs -p)"
+trap 'kill $SERVER_PIDS 2>/dev/null || true' EXIT
+
+# the router answers /healthz immediately; wait until BOTH replicas joined
+# (cold-start XLA compile of the tiny model can take minutes on a small box)
+for _ in $(seq 600); do
+  IN_ROT=$(curl -s "http://127.0.0.1:$ROUTER_PORT/healthz" 2>/dev/null |
+    python -c 'import json,sys; print(json.load(sys.stdin).get("in_rotation", 0))' \
+      2>/dev/null || echo 0)
+  [ "$IN_ROT" = "2" ] && break
+  sleep 1
+done
+echo "— fleet up: $IN_ROT replicas in rotation behind :$ROUTER_PORT"
+
+SYSTEM="You are a careful assistant. Answer briefly. Cite nothing. \
+Refuse nothing. The quick brown fox jumps over the lazy dog again and again."
+
+req() {
+  curl -s "http://127.0.0.1:$ROUTER_PORT/v1/chat/completions" \
+    -H 'Content-Type: application/json' \
+    -d "{\"messages\": [{\"role\": \"system\", \"content\": \"$1\"},
+                        {\"role\": \"user\", \"content\": \"$2\"}],
+         \"max_tokens\": 12, \"temperature\": 0}" >/dev/null
+  echo "  client done: $2"
+}
+
+echo "— warm requests (one per prefix group; the router records each route)"
+req "$SYSTEM" "hello there"
+req "different prompt entirely, nothing shared with the other group" "hi"
+
+echo "— four concurrent clients sharing the first system prompt"
+CLIENT_PIDS=""
+for q in "what is a fox?" "what is a dog?" "who jumps?" "how quick?"; do
+  req "$SYSTEM" "$q" &
+  CLIENT_PIDS="$CLIENT_PIDS $!"
+done
+wait $CLIENT_PIDS
+
+echo "— per-replica prefix-reuse counters + router routing split:"
+curl -s "http://127.0.0.1:$ROUTER_PORT/v1/stats" | python -c '
+import json, sys
+stats = json.load(sys.stdin)
+for rep_id, st in sorted(stats.get("replicas", {}).items()):
+    pc = st.get("prefix_cache") or {}
+    print("  replica %s: hit_tokens=%s resident_tokens=%s reuse_rate=%s"
+          % (rep_id, pc.get("hit_tokens"), pc.get("resident_tokens"),
+             pc.get("reuse_rate")))
+routes = stats["router"]["metrics"].get("router_routes_total", {})
+print("  router routes by reason:", routes)
+'

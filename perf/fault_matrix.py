@@ -11,7 +11,12 @@ then asserts the INVARIANTS the resilience layer promises (docs/ROBUSTNESS.md):
 - no slot leak: every slot is free, the queue is empty, and no prefix-cache
   lease stays pinned once the cell's requests are done;
 - the sequential / paged Engine stays usable: reset + a short fault-free
-  generation succeeds after every cell.
+  generation succeeds after every cell;
+- the fleet router (fleet/router.py over two model-free stub replicas)
+  survives `router.proxy` / `router.health` chaos: the membership poller
+  thread stays alive, ejected replicas rejoin on the next clean poll, a
+  fault-free probe request proxies end-to-end, and no router-side inflight
+  count leaks.
 
 Individual requests inside a cell MAY fail — that is the point of an
 injected error — the matrix only fails when the process-level invariants
@@ -43,6 +48,7 @@ BATCH_POINTS = ("batch.submit", "batch.cache_seed", "batch.prefill",
                 "device_loop.batched_dispatch")
 ENGINE_POINTS = ("engine.dispatch", "device_loop.dispatch")
 PAGED_POINTS = ("paged.append", "paged.cold_attend")
+ROUTER_POINTS = ("router.proxy", "router.health")
 # api.request is HTTP-layer; its shed/validation/drain behavior is asserted
 # against a live server in tests/test_resilience.py, not here.
 
@@ -150,6 +156,116 @@ def run_engine_cell(spec, eng, point: str, kind: str,
     return problems
 
 
+def build_router_fleet():
+    """Fleet-tier family harness: the REAL router over two model-free stub
+    replicas (stdlib HTTP servers answering /healthz and completions) — the
+    router's fault points live entirely in its proxy/poll paths, so the cells
+    need no engine. Returns (router_server, stub_servers)."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from distributed_llama_tpu.fleet.router import serve_router
+
+    class StubReplica(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            pass
+
+        def do_GET(self):
+            body = _json.dumps({"status": "ok", "replica": {
+                "id": "stub", "model_hash": "deadbeef0000", "slots": 2,
+                "free_slots": 2, "queue_depth": 0, "draining": False,
+            }}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = _json.dumps({"choices": [{"message": {
+                "role": "assistant", "content": "ok"},
+                "finish_reason": "stop", "index": 0}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    stubs = []
+    for _ in range(2):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), StubReplica)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        stubs.append(srv)
+    router = serve_router(
+        [f"127.0.0.1:{s.server_address[1]}" for s in stubs],
+        host="127.0.0.1", port=0, poll_interval=0.2, poll_timeout=2.0,
+        retries=2, try_timeout=10.0)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return router, stubs
+
+
+def run_router_cell(router, point: str, kind: str) -> list[str]:
+    """One fleet cell: inject at `point`, drive proxied requests + a poll,
+    then assert the fleet-level invariants — the membership poller thread
+    survives, a fault-free probe request completes end-to-end, rotation
+    recovers to both stubs, and no router-side inflight count leaks."""
+    import http.client
+    import json as _json
+
+    state = router.router_state
+    problems: list[str] = []
+
+    def post():
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", router.server_address[1], timeout=30)
+        try:
+            conn.request("POST", "/v1/chat/completions",
+                         _json.dumps({"messages": [
+                             {"role": "user", "content": f"{point}/{kind}"}],
+                             "max_tokens": 2}),
+                         {"Content-Type": "application/json"})
+            return conn.getresponse().status
+        finally:
+            conn.close()
+
+    with faults.active(_spec_for(point, kind)):
+        state.membership.poll_once()
+        for _ in range(2):
+            try:
+                post()  # MAY 503 under injected proxy errors — that is the cell
+            except Exception:
+                pass
+    faults.uninstall()
+    if not state.membership._thread.is_alive():
+        problems.append(f"{point}/{kind}: membership poller thread DIED")
+        return problems
+    state.membership.poll_once()  # clean poll: ejected stubs must rejoin
+    if len(state.membership.in_rotation()) != 2:
+        problems.append(f"{point}/{kind}: rotation did not recover "
+                        f"({[r.snapshot() for r in state.membership.replicas]})")
+    try:
+        status = post()
+        if status != 200:
+            problems.append(f"{point}/{kind}: fault-free probe got {status}")
+    except Exception as e:
+        problems.append(f"{point}/{kind}: fault-free probe failed: {e!r}")
+    # the probe client returns on response HEADERS; the handler thread
+    # decrements inflight in its finally a beat later — poll, don't race it
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaked = [r.id for r in state.membership.replicas if r.inflight != 0]
+        if not leaked:
+            break
+        time.sleep(0.01)
+    else:
+        problems.append(f"{point}/{kind}: router inflight leak on {leaked}")
+    return problems
+
+
 def run_matrix(include_paged: bool = True,
                kinds=KINDS) -> tuple[int, list[str]]:
     cells = 0
@@ -181,6 +297,19 @@ def run_matrix(include_paged: bool = True,
                 cells += 1
                 problems += run_engine_cell(pspec, peng, point, kind,
                                             paged=True)
+    router, stubs = build_router_fleet()
+    try:
+        for point in ROUTER_POINTS:
+            for kind in kinds:
+                cells += 1
+                problems += run_router_cell(router, point, kind)
+    finally:
+        from distributed_llama_tpu.fleet.router import close_router
+
+        close_router(router)
+        for s in stubs:
+            s.shutdown()
+            s.server_close()
     return cells, problems
 
 

@@ -97,6 +97,34 @@ def test_collective_bytes_model():
     assert collective_kbytes_per_token(spec, 1, False) == 0.0
 
 
+def test_collective_estimate_matches_measured_tp2():
+    """The analytic S/R model must agree with the MEASURED jaxpr accounting
+    of the compiled decode step (hlo_stats) on the CPU tp2 mesh — in BOTH
+    compression modes. The compressed case is the regression this pins: the
+    old single-phase quantized_psum all_gathered the full quantized payload
+    (n_dev x what the 34/32 model claimed); the two-phase scatter-reduce +
+    gather form in parallel/collectives.py makes the estimate true."""
+    from distributed_llama_tpu.models.spec import RopeType
+    from distributed_llama_tpu.obs import metrics
+
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=128, hidden_dim=256,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=64, rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=3)
+    for compress in (False, True):
+        eng = Engine(spec, params, tp=2, compress_collectives=compress)
+        measured = eng.collective_stats().sent_bytes_per_device
+        est = collective_kbytes_per_token(spec, 2, compress) * 1024.0
+        assert measured == pytest.approx(est, rel=0.01), (compress, measured, est)
+    # compressed wire bytes actually dropped vs the fp path
+    assert (collective_kbytes_per_token(spec, 2, True)
+            < collective_kbytes_per_token(spec, 2, False))
+    # collective_stats published the measured numbers as gauges
+    # (hlo_stats.publish_traffic) for /metrics
+    snap = metrics.snapshot().get("collective_sent_bytes_per_device") or {}
+    assert any("decode_t1" in k for k in snap), sorted(snap)
+
+
 def test_window_bucket_transitions_match_full(monkeypatch):
     """A generation that crosses window buckets (16 -> 32 -> full) must emit exactly
     the tokens of an engine that never windows: bucket growth only changes which dead

@@ -19,7 +19,8 @@ def test_fault_matrix_no_scheduler_death_or_slot_leak():
     # dispatches too (docs/SERVING.md "Pipelined decode")
     expected = (2 * len(fault_matrix.BATCH_POINTS)
                 + len(fault_matrix.ENGINE_POINTS)
-                + len(fault_matrix.PAGED_POINTS)) * len(fault_matrix.KINDS)
+                + len(fault_matrix.PAGED_POINTS)
+                + len(fault_matrix.ROUTER_POINTS)) * len(fault_matrix.KINDS)
     assert cells == expected, (cells, expected)
     assert not problems, "\n".join(problems)
 
@@ -29,7 +30,7 @@ def test_matrix_covers_documented_inventory():
     the matrix — adding a fire() site without matrix coverage is exactly the
     silent-cap failure mode this wrapper exists to prevent."""
     covered = set(fault_matrix.BATCH_POINTS + fault_matrix.ENGINE_POINTS
-                  + fault_matrix.PAGED_POINTS)
+                  + fault_matrix.PAGED_POINTS + fault_matrix.ROUTER_POINTS)
     doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
                             "ROBUSTNESS.md")).read()
     for point in covered:

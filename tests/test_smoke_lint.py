@@ -33,6 +33,18 @@ def test_scan_covers_cache_package():
     assert os.path.join("perf", "prefix_seed_bench.py") in rel
 
 
+def test_scan_covers_fleet_package():
+    """The fleet tier (ISSUE 6) rides the same repo-wide gate: router,
+    membership, affinity and the apps/router.py entrypoint must all be in
+    the compile + dead-import scan."""
+    files = smoke_lint.repo_py_files()
+    rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
+    for mod in ("router", "membership", "affinity", "__init__"):
+        assert os.path.join("distributed_llama_tpu", "fleet",
+                            f"{mod}.py") in rel, mod
+    assert os.path.join("distributed_llama_tpu", "apps", "router.py") in rel
+
+
 def test_fallback_checker_flags_planted_dead_import(tmp_path):
     """The AST fallback actually detects the defect class it exists for,
     and respects the noqa escape hatch."""
