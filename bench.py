@@ -150,6 +150,30 @@ def read_handoff():
             best = (payload, age)
     return best
 
+def _pct(sorted_vals, q):
+    """Percentile from an ascending list (None when empty) — p50/p95/p99
+    share one indexing convention across every workload report."""
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(int(len(sorted_vals) * q), len(sorted_vals) - 1)]
+
+
+def _pct_ms(sorted_vals, q):
+    v = _pct(sorted_vals, q)
+    return round(v * 1e3, 2) if v is not None else None
+
+
+def write_latency_log(path, samples):
+    """--latency-log out.jsonl: raw per-request samples (request id, ttft,
+    e2e, tokens, replica) so offline percentile analysis doesn't depend on
+    the pre-chosen p50/p95/p99 cuts."""
+    with open(path, "w") as f:
+        for s in samples:
+            f.write(json.dumps(s) + "\n")
+    print(f"# wrote {len(samples)} latency samples to {path}",
+          file=sys.stderr)
+
+
 LLAMA2_7B = dict(arch_type=ArchType.LLAMA, dim=4096, hidden_dim=11008, n_layers=32,
                  n_heads=32, n_kv_heads=32, vocab_size=32000, seq_len=2048,
                  rope_type=RopeType.LLAMA)
@@ -301,6 +325,7 @@ def shared_prefix_workload(args, spec):
     warmed by the leading request in both, so the delta isolates what the
     cache buys: the followers' shared-prefix prefill."""
     from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.obs import flight as obs_flight
     from distributed_llama_tpu.quants import FloatType as _FTy
     from distributed_llama_tpu.runtime.batch_engine import BatchEngine
     from distributed_llama_tpu.runtime.sampler import Sampler
@@ -319,54 +344,85 @@ def shared_prefix_workload(args, spec):
     # default: every follower gets a slot immediately, so TTFT isolates the
     # prefill the cache removes instead of queue wait behind busy slots
     B = args.batch if args.batch > 0 else min(max(n_req - 1, 2), 8)
+    # flight recorder: per-request engine-side timelines give the E2E
+    # percentiles and the --latency-log samples without per-request threads.
+    # The finally guarantees the process-global recorder is removed and the
+    # samples gathered so far are flushed even when a request fails mid-run.
+    rec = obs_flight.install(max(4 * n_req, 64))
+    samples = []
     out = {}
-    for label, on in (("on", True), ("off", False)):
-        be = BatchEngine(spec, params, slots=B,
-                         superstep=max(args.superstep, 1), tp=args.tp,
-                         prefix_cache=on)
-        try:
-            be.generate(list(prompts[0]), gen,
-                        Sampler(spec.vocab_size, temperature=0.0))
-            ttfts = {}
-            t0s = {}
+    try:
+        for label, on in (("on", True), ("off", False)):
+            be = BatchEngine(spec, params, slots=B,
+                             superstep=max(args.superstep, 1), tp=args.tp,
+                             prefix_cache=on)
+            try:
+                be.generate(list(prompts[0]), gen,
+                            Sampler(spec.vocab_size, temperature=0.0))
+                ttfts = {}
+                t0s = {}
 
-            def on_tok(i):
-                def cb(_t, i=i):
-                    if i not in ttfts:
-                        ttfts[i] = time.perf_counter() - t0s[i]
-                return cb
+                def on_tok(i):
+                    def cb(_t, i=i):
+                        if i not in ttfts:
+                            ttfts[i] = time.perf_counter() - t0s[i]
+                    return cb
 
-            reqs = []
-            for i in range(1, n_req):
-                t0s[i] = time.perf_counter()
-                reqs.append(be.submit(list(prompts[i]), gen,
-                                      Sampler(spec.vocab_size, temperature=0.0),
-                                      on_token=on_tok(i)))
-            t_all0 = time.perf_counter()
-            for r in reqs:
-                r.wait(timeout=600)
-            e2e = time.perf_counter() - t_all0
-            lat = sorted(ttfts.values())
-            out[label] = {
-                "ttft_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
-                "ttft_p95_ms": round(
-                    lat[min(int(len(lat) * 0.95), len(lat) - 1)] * 1e3, 2),
-                "e2e_s": round(e2e, 3),
-            }
-            if on:
-                st = be.prefix_cache.stats()
-                out["prefix_hit_rate"] = round(st["hit_rate"], 3)
-                out["lookup_hit_rate"] = round(st["lookup_hit_rate"], 3)
-                out["hit_tokens"] = st["hit_tokens"]
-                out["pool_blocks"] = st["pool_blocks"]
-        finally:
-            be.close()
+                reqs = []
+                for i in range(1, n_req):
+                    t0s[i] = time.perf_counter()
+                    reqs.append(be.submit(
+                        list(prompts[i]), gen,
+                        Sampler(spec.vocab_size, temperature=0.0),
+                        on_token=on_tok(i), rid=f"bench-{label}-{i}"))
+                t_all0 = time.perf_counter()
+                for r in reqs:
+                    r.wait(timeout=600)
+                e2e = time.perf_counter() - t_all0
+                # per-request E2E from the flight recorder (submit ->
+                # engine finish), the per-request number the wall clock
+                # above can't give
+                req_e2e = []
+                for i, r in enumerate(reqs, start=1):
+                    fr = rec.get(f"bench-{label}-{i}") or {}
+                    if fr.get("e2e_ms") is not None:
+                        req_e2e.append(fr["e2e_ms"] / 1e3)
+                    samples.append({"request_id": f"bench-{label}-{i}",
+                                    "cache": label,
+                                    "ttft_s": ttfts.get(i),
+                                    "e2e_s": fr.get("e2e_ms", 0.0) / 1e3
+                                    or None,
+                                    "tokens": len(r.out), "replica": None})
+                req_e2e.sort()
+                lat = sorted(ttfts.values())
+                out[label] = {
+                    "ttft_p50_ms": _pct_ms(lat, 0.50),
+                    "ttft_p95_ms": _pct_ms(lat, 0.95),
+                    "ttft_p99_ms": _pct_ms(lat, 0.99),
+                    "e2e_p99_ms": _pct_ms(req_e2e, 0.99),
+                    "e2e_s": round(e2e, 3),
+                }
+                if on:
+                    st = be.prefix_cache.stats()
+                    out["prefix_hit_rate"] = round(st["hit_rate"], 3)
+                    out["lookup_hit_rate"] = round(st["lookup_hit_rate"], 3)
+                    out["hit_tokens"] = st["hit_tokens"]
+                    out["pool_blocks"] = st["pool_blocks"]
+            finally:
+                be.close()
+    finally:
+        obs_flight.uninstall()
+        if args.latency_log and samples:
+            write_latency_log(args.latency_log, samples)
     print(json.dumps({
         "metric": "shared_prefix_ttft_p50_ms",
         "value": out["on"]["ttft_p50_ms"], "unit": "ms", "vs_baseline": None,
         "ttft_p95_ms": out["on"]["ttft_p95_ms"],
+        "ttft_p99_ms": out["on"]["ttft_p99_ms"],
+        "e2e_p99_ms": out["on"]["e2e_p99_ms"],
         "ttft_off_p50_ms": out["off"]["ttft_p50_ms"],
         "ttft_off_p95_ms": out["off"]["ttft_p95_ms"],
+        "ttft_off_p99_ms": out["off"]["ttft_p99_ms"],
         "ttft_speedup_p50": round(
             out["off"]["ttft_p50_ms"] / max(out["on"]["ttft_p50_ms"], 1e-9), 3),
         "e2e_s_on": out["on"]["e2e_s"], "e2e_s_off": out["off"]["e2e_s"],
@@ -440,16 +496,25 @@ def fleet_shared_prefix_workload(args, spec):
     repo_root = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root,
                DLT_HANDOFF_PATH="", DLLAMA_FAULTS="", DLLAMA_FAULT_SEED="")
+    if args.trace_fleet and obs_trace.current() is None:
+        # the router runs in THIS process: its proxy spans must record for
+        # the merged fleet trace (replicas get --trace below)
+        obs_trace.install(process_name="router")
     procs, logs = [], []
     for port in ports:
         log = open(os.path.join(tmp, f"replica_{port}.log"), "w")
         logs.append(log)
+        argv = [sys.executable, "-m", "distributed_llama_tpu.apps.api_server",
+                "--model", mpath, "--tokenizer", tpath, "--chat-template",
+                "chatml", "--host", "127.0.0.1", "--port", str(port),
+                "--batch", "2", "--superstep", "4", "--drain-timeout", "60"]
+        if args.trace_fleet:
+            # replica-side tracing: the router's GET /v1/trace pulls each
+            # replica's live buffer into the merged Perfetto file
+            argv += ["--trace", os.path.join(tmp, f"trace_{port}.json")]
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", "distributed_llama_tpu.apps.api_server",
-             "--model", mpath, "--tokenizer", tpath, "--chat-template",
-             "chatml", "--host", "127.0.0.1", "--port", str(port),
-             "--batch", "2", "--superstep", "4", "--drain-timeout", "60"],
-            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=repo_root))
+            argv, env=env, stdout=log, stderr=subprocess.STDOUT,
+            cwd=repo_root))
 
     def _get_json(port, path, timeout=10):
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
@@ -502,7 +567,7 @@ def fleet_shared_prefix_workload(args, spec):
         gen = 8
         followers = max(args.requests - 1, 4)  # per group, measured phase
 
-        def one_request(system, user, results, idx):
+        def one_request(system, user, results, idx, headers=None):
             t0 = time.perf_counter()
             body = {"messages": [{"role": "system", "content": system},
                                  {"role": "user", "content": user}],
@@ -510,8 +575,11 @@ def fleet_shared_prefix_workload(args, spec):
             try:
                 conn = http.client.HTTPConnection("127.0.0.1", rport,
                                                   timeout=180)
+                hdrs = {"Content-Type": "application/json"}
+                if headers:
+                    hdrs.update(headers)
                 conn.request("POST", "/v1/chat/completions", json.dumps(body),
-                             {"Content-Type": "application/json"})
+                             hdrs)
                 resp = conn.getresponse()
                 if resp.status != 200:
                     results[idx] = {"error": f"status {resp.status}"}
@@ -534,7 +602,13 @@ def fleet_shared_prefix_workload(args, spec):
                         deltas += 1
                         if ttft is None:
                             ttft = time.perf_counter() - t0
-                results[idx] = {"ttft": ttft, "deltas": deltas}
+                results[idx] = {"ttft": ttft, "deltas": deltas,
+                                "e2e": time.perf_counter() - t0,
+                                # serving identity for --latency-log and the
+                                # flight-recorder acceptance check (relayed
+                                # by the router from the replica)
+                                "rid": resp.getheader("X-Request-Id"),
+                                "replica": resp.getheader("X-Replica")}
             except Exception as e:
                 results[idx] = {"error": repr(e)}
             finally:
@@ -562,11 +636,18 @@ def fleet_shared_prefix_workload(args, spec):
         threads = []
         t_all0 = time.perf_counter()
         sem = threading.Semaphore(2 * n_rep)  # fleet-wide client concurrency
+        # the SAMPLED request (--trace-fleet acceptance): send an explicit
+        # client traceparent on follower 0 so its known trace id can be
+        # asserted in both the router's proxy span and the serving replica's
+        # engine spans inside the merged trace
+        sampled_tid = os.urandom(16).hex()
+        sampled_hdr = {"traceparent": f"00-{sampled_tid}-{os.urandom(8).hex()}-01"}
 
         def run_one(i, g, f):
             with sem:
                 one_request(systems[g], f"follower {f} of group {g}",
-                            results, i)
+                            results, i,
+                            headers=sampled_hdr if i == 0 else None)
 
         for i, (g, f) in enumerate(reqs):
             if kill_at is not None and i == kill_at:
@@ -586,7 +667,69 @@ def fleet_shared_prefix_workload(args, spec):
                   if r is None or "error" in r]
         ttfts = sorted(r["ttft"] for r in results
                        if r and r.get("ttft") is not None)
+        e2es = sorted(r["e2e"] for r in results
+                      if r and r.get("e2e") is not None)
         deltas = sum(r.get("deltas", 0) for r in results if r)
+
+        if args.latency_log:
+            write_latency_log(args.latency_log, [
+                {"request_id": (r or {}).get("rid"), "group": g,
+                 "follower": f, "ttft_s": (r or {}).get("ttft"),
+                 "e2e_s": (r or {}).get("e2e"),
+                 "tokens": (r or {}).get("deltas"),
+                 "replica": (r or {}).get("replica"),
+                 "error": (r or {}).get("error")}
+                for (g, f), r in zip(reqs, results)])
+
+        # --trace-fleet acceptance: pull the router's fleet-merged Perfetto
+        # trace, write it, and verify end-to-end attribution — the sampled
+        # request's router proxy span AND its replica-side engine events
+        # carry the trace id the client sent, and the serving replica's
+        # flight recorder returns that request's full timeline
+        trace_info = None
+        if args.trace_fleet:
+            _, doc = _get_json(rport, "/v1/trace", timeout=60)
+            with open(args.trace_fleet, "w") as f:
+                json.dump(doc, f)
+            evs = doc.get("traceEvents", [])
+            router_spans = [
+                e for e in evs if e.get("name") == "router.proxy"
+                and (e.get("args") or {}).get("trace_id") == sampled_tid]
+            engine_evs = [
+                e for e in evs
+                if (e.get("args") or {}).get("trace_id") == sampled_tid
+                and str(e.get("name", "")).startswith(("batch.", "engine."))]
+            r0 = results[0] or {}
+            timeline = None
+            if r0.get("rid") and r0.get("replica"):
+                try:
+                    st, body = _get_json(
+                        int(r0["replica"].rsplit(":", 1)[1]),
+                        f"/v1/requests/{r0['rid']}", timeout=10)
+                    timeline = body if st == 200 else None
+                except OSError:
+                    timeline = None
+            tl_events = [e.get("event")
+                         for e in (timeline or {}).get("events", [])]
+            trace_info = {
+                "out": args.trace_fleet, "events": len(evs),
+                "processes": len((doc.get("otherData") or {})
+                                 .get("processes", [])),
+                "sampled_trace_id": sampled_tid,
+                "sampled_request_id": r0.get("rid"),
+                "sampled_replica": r0.get("replica"),
+                "router_proxy_spans": len(router_spans),
+                "replica_engine_events": len(engine_evs),
+                "flight_timeline_events": len(tl_events),
+                "flight_has_queue_and_steps": (
+                    "admitted" in tl_events
+                    and any(e in ("super_step", "prefill_chunk")
+                            for e in tl_events)),
+                "ok": bool(router_spans and engine_evs
+                           and timeline is not None
+                           and timeline.get("finish") is not None
+                           and "admitted" in tl_events),
+            }
 
         # aggregate prefix-hit-rate over every replica (the victim from its
         # pre-kill snapshot; survivors live — the victim is NEVER polled
@@ -628,11 +771,13 @@ def fleet_shared_prefix_workload(args, spec):
             "failures": [f"{i}: {r}" for i, r in failed[:5]],
             "requests": len(reqs), "groups": groups,
             "followers_per_group": followers,
-            "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 2)
-            if ttfts else None,
-            "ttft_p95_ms": round(
-                ttfts[min(int(len(ttfts) * 0.95), len(ttfts) - 1)] * 1e3, 2)
-            if ttfts else None,
+            "ttft_p50_ms": _pct_ms(ttfts, 0.50),
+            "ttft_p95_ms": _pct_ms(ttfts, 0.95),
+            "ttft_p99_ms": _pct_ms(ttfts, 0.99),
+            "e2e_p50_ms": _pct_ms(e2es, 0.50),
+            "e2e_p95_ms": _pct_ms(e2es, 0.95),
+            "e2e_p99_ms": _pct_ms(e2es, 0.99),
+            "trace_fleet": trace_info,
             # reuse = pool hits + resident rewinds: WHICH mechanism skipped a
             # request's prefill is a slot-scheduling accident (the same sticky
             # route lands either way), so the acceptance metric sums both;
@@ -647,6 +792,11 @@ def fleet_shared_prefix_workload(args, spec):
             "shared_prefix_chars": sys_len, "gen_tokens": gen,
         }))
         if failed:
+            sys.exit(1)
+        if (trace_info is not None and not trace_info["ok"]
+                and not args.kill_replica):
+            # acceptance gate: a merged trace without end-to-end attribution
+            # (or a missing flight timeline) is a failure, not a warning
             sys.exit(1)
     finally:
         if router is not None:
@@ -766,6 +916,7 @@ def chaos_workload(args, spec):
     be = BatchEngine(spec, params, slots=B,
                      superstep=max(args.superstep, 1), tp=args.tp)
     out = {}
+    samples = []
     try:
         # warm every compiled shape so both runs measure dispatch, not compile
         be.generate(list(prompts[0]), gen,
@@ -794,11 +945,17 @@ def chaos_workload(args, spec):
                         on_token=on_tok(i)))
                 failed = 0
                 tokens = 0
-                for r in reqs:
+                for i, r in enumerate(reqs):
+                    err = None
                     try:
                         tokens += len(r.wait(timeout=600))
-                    except Exception:
+                    except Exception as ex:
                         failed += 1
+                        err = repr(ex)
+                    samples.append({"request_id": r.rid, "phase": label,
+                                    "ttft_s": ttfts.get(i), "e2e_s": None,
+                                    "tokens": len(r.out), "replica": None,
+                                    "error": err})
                 e2e = time.perf_counter() - t_all0
             finally:
                 _faults.uninstall()
@@ -807,14 +964,15 @@ def chaos_workload(args, spec):
                 "tok_s": round(tokens / e2e, 3),
                 # None, not a crash, when every request died pre-first-token
                 # (e.g. --fault-rate 1.0 exhausts every dispatch's retries)
-                "ttft_p95_ms": round(
-                    lat[min(int(len(lat) * 0.95), len(lat) - 1)] * 1e3, 2)
-                if lat else None,
+                "ttft_p95_ms": _pct_ms(lat, 0.95),
+                "ttft_p99_ms": _pct_ms(lat, 0.99),
                 "failed_requests": failed,
                 "injected": plan.fired() if plan is not None else 0,
             }
     finally:
         be.close()
+    if args.latency_log:
+        write_latency_log(args.latency_log, samples)
     base, chaos = out["baseline"], out["chaos"]
     print(json.dumps({
         "metric": "chaos_survivor_tok_s",
@@ -823,7 +981,9 @@ def chaos_workload(args, spec):
         "degradation_pct": round(
             100.0 * (1.0 - chaos["tok_s"] / max(base["tok_s"], 1e-9)), 2),
         "ttft_p95_ms": chaos["ttft_p95_ms"],
+        "ttft_p99_ms": chaos["ttft_p99_ms"],
         "ttft_p95_baseline_ms": base["ttft_p95_ms"],
+        "ttft_p99_baseline_ms": base["ttft_p99_ms"],
         "fault_rate": args.fault_rate,
         "injected_faults": chaos["injected"],
         "failed_requests": chaos["failed_requests"],
@@ -989,6 +1149,18 @@ def main():
                     help="record per-dispatch spans of the timed region and "
                          "write Chrome trace-event JSON (obs/trace.py; open "
                          "in ui.perfetto.dev)")
+    ap.add_argument("--trace-fleet", default=None, metavar="OUT.json",
+                    help="with --replicas N: enable tracing on the router "
+                         "AND every replica subprocess, pull the router's "
+                         "GET /v1/trace at the end, and write ONE merged "
+                         "Perfetto file where a request's router proxy span "
+                         "and its replica engine spans share a trace id "
+                         "(docs/OBSERVABILITY.md); also verifies a sampled "
+                         "request's flight-recorder timeline end-to-end")
+    ap.add_argument("--latency-log", default=None, metavar="OUT.jsonl",
+                    help="workload modes: dump raw per-request samples "
+                         "(request id, ttft, e2e, tokens, replica) as JSONL "
+                         "for offline percentile analysis")
     ap.add_argument("--no-fuse", action="store_true",
                     help="keep wq/wk/wv and w1/w3 as separate kernel launches "
                          "instead of the merged wqkv/w13 groups (A/B lever)")
@@ -1045,6 +1217,12 @@ def main():
                  "single-replica baseline the acceptance compares against")
     if args.kill_replica and not args.replicas:
         ap.error("--kill-replica requires --replicas N")
+    if args.trace_fleet and not args.replicas:
+        ap.error("--trace-fleet requires --replicas N (the fleet tier of "
+                 "--workload shared-prefix)")
+    if args.latency_log and not args.workload:
+        ap.error("--latency-log applies to --workload modes (per-request "
+                 "samples need a request workload)")
     if args.kv_paged > 0 and args.tp > 1:
         # before any mesh/device work so the error beats a mesh-size crash
         ap.error("--kv-paged is single-chip (the paged step is an unsharded "

@@ -24,8 +24,10 @@ import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
-from ..obs import metrics
+from ..obs import flight, metrics, reqctx, trace
+from ..obs.process import install_process_metrics
 from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
                                  EngineDraining, EngineSaturated,
@@ -51,11 +53,16 @@ _HTTP = metrics.counter(
     labelnames=("route", "code"))
 
 _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
-                 "/v1/stats", "/metrics", "/health", "/healthz")
+                 "/v1/stats", "/metrics", "/health", "/healthz",
+                 "/v1/requests", "/v1/trace")
 
 
 def _count_http(path: str, code: int) -> None:
-    # unknown paths collapse to one label value so scrapes stay bounded
+    # unknown paths collapse to one label value so scrapes stay bounded;
+    # per-request flight lookups collapse to their route prefix
+    path = path.split("?", 1)[0]
+    if path.startswith("/v1/requests/"):
+        path = "/v1/requests"
     route = path if path in _KNOWN_ROUTES else "other"
     _HTTP.labels(route=route, code=str(code)).inc()
 
@@ -84,6 +91,7 @@ class ApiState:
         # replica identity (docs/FLEET.md): set to host:port once the server
         # socket binds (serve()); what the router's membership poller reads
         self.replica_id = ""
+        self.started_mono = time.monotonic()  # /healthz uptime_s
         self.batch_engine = batch_engine  # BatchEngine when --batch > 1, else None
         self.lock = threading.Lock()
         # graceful drain (docs/ROBUSTNESS.md): set by begin_drain/SIGTERM —
@@ -121,9 +129,13 @@ def _now() -> int:
     return int(time.time())
 
 
-def _completion_payload(state: ApiState, text: str, finish: str) -> dict:
+def _completion_payload(state: ApiState, text: str, finish: str,
+                        rid: str | None = None) -> dict:
+    # `rid` is the serving request id (the flight-recorder key): reusing it
+    # as the completion id makes GET /v1/requests/<id> reachable straight
+    # from the client-visible response
     return {
-        "id": f"chatcmpl-{uuid.uuid4().hex[:12]}",
+        "id": rid or f"chatcmpl-{uuid.uuid4().hex[:12]}",
         "object": "chat.completion",
         "created": _now(),
         "model": state.model_name,
@@ -164,9 +176,16 @@ def _load_block(state: "ApiState") -> dict:
                 "queue_depth": 0}
         draining = state.draining
     spec = (be or state.engine).spec
+    import os
+
     return {"id": state.replica_id, "model": state.model_name,
             "model_hash": model_config_hash(spec),
-            "batched": be is not None, "draining": bool(draining), **load}
+            "batched": be is not None, "draining": bool(draining),
+            # process identity/health for the fleet poller: pid matches the
+            # replica's trace export, uptime catches restart loops
+            "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - state.started_mono, 1),
+            **load}
 
 
 def _stats_payload(state: "ApiState") -> dict:
@@ -209,11 +228,21 @@ def _opt(body: dict, key: str, default):
     return default if v is None else v
 
 
-def _observe_done(t_start: float, ttft: list, n_tokens: int) -> None:
+def _observe_done(t_start: float, ttft: list, n_tokens: int,
+                  finish: str | None = None) -> None:
     dt = time.perf_counter() - t_start
     _E2E.observe(dt)
+    tpot = None
     if ttft[0] is not None and n_tokens > 1:
-        _TPOT.observe((dt - ttft[0]) / (n_tokens - 1))
+        tpot = (dt - ttft[0]) / (n_tokens - 1)
+        _TPOT.observe(tpot)
+    # complete the flight-recorder timeline with the request-level numbers
+    # only the HTTP layer knows (rid resolves from the bound trace context)
+    flight.finish(
+        None, finish,
+        ttft_ms=round(ttft[0] * 1e3, 3) if ttft[0] is not None else None,
+        tpot_ms=round(tpot * 1e3, 3) if tpot is not None else None,
+        e2e_ms=round(dt * 1e3, 3), tokens=n_tokens)
 
 
 def run_completion(state: ApiState, body: dict, emit):
@@ -225,6 +254,12 @@ def run_completion(state: ApiState, body: dict, emit):
     faults.fire("api.request")
     if state.draining:
         raise EngineDraining("server is draining (shutting down)")
+    rc = reqctx.current()
+    if rc is not None:
+        # open the flight-recorder timeline at the HTTP boundary (the
+        # BatchEngine enriches the same record from the scheduler side)
+        flight.start(rc.request_id, rc.trace_id, replica=state.replica_id,
+                     stream=bool(body.get("stream", False)))
     t_start = time.perf_counter()
     ttft: list = [None]
     user_emit = emit
@@ -313,7 +348,7 @@ def run_completion(state: ApiState, body: dict, emit):
             # deadline expired mid-generation WITH partial output: deliver
             # what exists, finish_reason says why it stopped early
             finish[0] = "deadline"
-        _observe_done(t_start, ttft, req.stats.generated_tokens)
+        _observe_done(t_start, ttft, req.stats.generated_tokens, finish[0])
         return "".join(pieces), finish[0]
 
     engine = state.engine
@@ -368,8 +403,22 @@ def run_completion(state: ApiState, body: dict, emit):
     # only tokens whose KV was actually written are reusable (a final stop token is
     # sampled but never inferred, so engine.pos may be one short of prompt+out)
     state.cache.end((prompt + out)[: engine.pos])
-    _observe_done(t_start, ttft, len(out))
+    _observe_done(t_start, ttft, len(out), finish[0])
     return "".join(pieces), finish[0]
+
+
+def _flight_error(rid: str, e: Exception) -> None:
+    """Complete (or discard) the flight record of a failed completion.
+    Admission sheds (saturated/draining/closed 503s) and caller errors
+    (ValueError covers InvalidRequest and template/encode failures — the
+    400 class) are DROPPED: both arrive at client-request rate, and
+    finishing each one would flood --slow-log and churn every real
+    timeline out of the ring exactly when the recorder matters most.
+    Server-side failures (500s, deadline expiries) stay exemplars."""
+    if isinstance(e, (EngineSaturated, EngineClosed, ValueError)):
+        flight.drop(rid)
+    else:
+        flight.finish(rid, None, error=str(e))
 
 
 def _map_error(e: Exception) -> tuple[int, str, float | None]:
@@ -412,18 +461,40 @@ class Handler(BaseHTTPRequestHandler):
                   extra_headers)
 
     def _error(self, code: int, message: str, etype: str,
-               retry_after: float | None = None):
+               retry_after: float | None = None,
+               extra_headers: dict | None = None):
         """OpenAI-style error body: {"error": {"message", "type"}} — clients
         built against the OpenAI SDK parse this shape, not bare strings.
         Load-shed 503s carry Retry-After so clients back off instead of
         hammering a saturated queue."""
-        hdrs = ({"Retry-After": str(max(int(retry_after + 0.5), 1))}
-                if retry_after is not None else None)
-        self._json(code, {"error": {"message": message, "type": etype}}, hdrs)
+        hdrs = dict(extra_headers or {})
+        if retry_after is not None:
+            hdrs["Retry-After"] = str(max(int(retry_after + 0.5), 1))
+        self._json(code, {"error": {"message": message, "type": etype}},
+                   hdrs or None)
 
-    def _mapped_error(self, e: Exception):
+    def _mapped_error(self, e: Exception, rid: str | None = None):
+        # errored requests are the flight recorder's PRIMARY exemplars:
+        # the error response must reveal the lookup key (X-Request-Id)
+        # or the operator can never reach GET /v1/requests/<id> for it
         code, etype, retry_after = _map_error(e)
-        self._error(code, str(e), etype, retry_after)
+        hdrs = ({"X-Request-Id": rid, "X-Replica": self._replica_addr()}
+                if rid else None)
+        self._error(code, str(e), etype, retry_after, hdrs)
+
+    def _replica_addr(self) -> str:
+        """Routable replica address for the X-Replica header. A server bound
+        to 0.0.0.0 would advertise an unroutable wildcard; the address the
+        CLIENT actually connected to (this connection's local sockname) is
+        reachable by that client by construction."""
+        rid = self.state.replica_id
+        if not rid.startswith("0.0.0.0:"):
+            return rid
+        try:
+            host, port = self.connection.getsockname()[:2]
+            return f"{host}:{port}"
+        except (OSError, ValueError):
+            return rid
 
     def do_GET(self):
         if self.path == "/v1/models":
@@ -451,8 +522,47 @@ class Handler(BaseHTTPRequestHandler):
                       metrics.render().encode())
         elif self.path == "/v1/stats":
             self._json(200, _stats_payload(self.state))
+        elif self.path.split("?", 1)[0] == "/v1/requests" \
+                or self.path.startswith("/v1/requests/"):
+            self._get_requests()
+        elif self.path == "/v1/trace":
+            # this replica's live Chrome trace (the fleet router's /v1/trace
+            # pulls these from every replica and merges them)
+            t = trace.current()
+            if t is None:
+                self._error(404, "tracing is not enabled on this replica "
+                            "(start with --trace)", "invalid_request_error")
+            else:
+                self._json(200, t.to_chrome_trace())
         else:
             self._error(404, f"Unknown route: {self.path}", "invalid_request_error")
+
+    def _get_requests(self):
+        """GET /v1/requests[?slowest=K] | /v1/requests/<id>: the flight
+        recorder's per-request timelines (docs/OBSERVABILITY.md)."""
+        rec = flight.current()
+        if rec is None:
+            self._error(404, "flight recorder is not enabled",
+                        "invalid_request_error")
+            return
+        parts = urlsplit(self.path)
+        if parts.path.startswith("/v1/requests/"):
+            key = parts.path[len("/v1/requests/"):]
+            r = rec.get(key)
+            if r is None:
+                self._error(404, f"no flight record for {key!r} (ring keeps "
+                            f"the last {rec.capacity} completed requests)",
+                            "invalid_request_error")
+            else:
+                self._json(200, r)
+            return
+        try:
+            slowest = int(parse_qs(parts.query).get("slowest", ["0"])[0])
+        except ValueError:
+            self._error(400, "'slowest' must be an integer",
+                        "invalid_request_error")
+            return
+        self._json(200, rec.requests(slowest=slowest))
 
     def do_POST(self):
         if self.path not in ("/v1/chat/completions", "/chat/completions"):
@@ -471,17 +581,23 @@ class Handler(BaseHTTPRequestHandler):
             return
         stream = bool(body.get("stream", False))
         state = self.state
+        # request identity (docs/OBSERVABILITY.md "Request tracing"): adopt
+        # the inbound W3C traceparent (the fleet router stamps one on every
+        # proxied hop; any W3C-speaking client works too) or originate a
+        # trace here; the completion id doubles as the flight-recorder key
+        rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+        ctx = reqctx.adopt(self.headers.get("traceparent"), request_id=rid)
         # batched mode: the scheduler serializes device access itself, so concurrent
         # requests proceed without the server-side lock (they share decode steps)
         import contextlib
         guard = contextlib.nullcontext() if state.batch_engine is not None else state.lock
-        with guard:
+        with guard, reqctx.use(ctx):
             if stream:
                 # SSE headers are DEFERRED to the first delta: an error
                 # raised before any output (validation, load shed, drain,
                 # queue-TTL expiry) gets its real status code (400/503/408)
                 # instead of a 200 stream carrying an error event
-                completion_id = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+                completion_id = rid
                 started = [False]
 
                 def _start_stream():
@@ -489,6 +605,8 @@ class Handler(BaseHTTPRequestHandler):
                     self.send_header("Content-Type", "text/event-stream")
                     self.send_header("Cache-Control", "no-cache")
                     self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("X-Request-Id", rid)
+                    self.send_header("X-Replica", self._replica_addr())
                     self.end_headers()
                     _count_http(self.path, 200)
                     started[0] = True
@@ -502,8 +620,9 @@ class Handler(BaseHTTPRequestHandler):
                 try:
                     _text, finish = run_completion(state, body, emit)
                 except Exception as e:
+                    _flight_error(rid, e)
                     if not started[0]:  # nothing sent: honest status code
-                        self._mapped_error(e)
+                        self._mapped_error(e, rid)
                         return
                     # mid-stream: error as SSE event, then terminate
                     self._write_chunk(
@@ -525,9 +644,13 @@ class Handler(BaseHTTPRequestHandler):
             else:
                 try:
                     text, finish = run_completion(state, body, lambda _t: None)
-                    self._json(200, _completion_payload(state, text, finish))
+                    self._json(200, _completion_payload(state, text, finish,
+                                                        rid),
+                               {"X-Request-Id": rid,
+                                "X-Replica": self._replica_addr()})
                 except Exception as e:
-                    self._mapped_error(e)
+                    _flight_error(rid, e)
+                    self._mapped_error(e, rid)
 
     def _write_chunk(self, data: bytes):
         self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
@@ -541,7 +664,9 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           speculative_k: int = 0, prefix_cache=True,
           prefix_cache_blocks: int = 0, prefix_block_tokens: int = 16,
           prefix_cache_q80: bool = False,
-          request_deadline: float = 0.0) -> ThreadingHTTPServer:
+          request_deadline: float = 0.0, flight_requests: int = 256,
+          slow_log: str | None = None,
+          slow_threshold: float = 1.0) -> ThreadingHTTPServer:
     if batch_engine is not None and speculative_k > 0:
         # guard EVERY caller, not just the CLI: the batch scheduler has no
         # per-request verify dispatch, so the flag would be silently inert
@@ -561,6 +686,18 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
     server.api_state = state  # drain controller / tests reach the state here
     # bound port is only known now (port=0 binds ephemeral in tests/benches)
     state.replica_id = f"{host}:{server.server_address[1]}"
+    # flight recorder (docs/OBSERVABILITY.md "Request tracing"): always on —
+    # a bounded ring of recent request timelines costs a few dict appends
+    # per request, and GET /v1/requests must answer "why was THIS slow"
+    # without a restart. A pre-installed recorder (tests, shared processes)
+    # is kept ONLY when this server asked for defaults; explicit flight
+    # flags must win, not silently no-op against the older instance.
+    if (flight.current() is None or slow_log is not None
+            or flight_requests != 256 or slow_threshold != 1.0):
+        flight.install(flight_requests, slow_log=slow_log,
+                       slow_threshold=slow_threshold)
+    install_process_metrics()
+    trace.set_process_name(f"api_server {state.replica_id}")
     print(f"🟢 dllama-api listening on {host}:{port}")
     return server
 
@@ -674,6 +811,18 @@ def main(argv=None) -> None:
                    help="SIGTERM graceful drain: /healthz flips to 503 "
                         "'draining', admissions stop, in-flight requests get "
                         "up to S seconds to finish before the server closes")
+    p.add_argument("--flight-requests", type=int, default=256, metavar="N",
+                   help="flight recorder ring: keep the last N completed "
+                        "request timelines for GET /v1/requests "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--slow-log", default=None, metavar="OUT.jsonl",
+                   help="append every request slower than --slow-threshold "
+                        "as one JSON line (its full flight-recorder "
+                        "timeline) — durable exemplars after the ring "
+                        "rotates")
+    p.add_argument("--slow-threshold", type=float, default=1.0, metavar="S",
+                   help="E2E seconds over which a request lands in "
+                        "--slow-log (default 1.0)")
     args = p.parse_args(argv)
     from .dllama import dump_trace, install_trace
 
@@ -739,7 +888,10 @@ def main(argv=None) -> None:
                    prefix_cache_blocks=args.prefix_cache_blocks,
                    prefix_block_tokens=args.prefix_cache_block_tokens,
                    prefix_cache_q80=args.prefix_cache_q80,
-                   request_deadline=args.request_deadline)
+                   request_deadline=args.request_deadline,
+                   flight_requests=args.flight_requests,
+                   slow_log=args.slow_log,
+                   slow_threshold=args.slow_threshold)
     # SIGTERM -> graceful drain (docs/ROBUSTNESS.md): /healthz flips to
     # draining, admissions stop, in-flight requests finish, then shutdown
     install_sigterm_drain(server, server.api_state, args.drain_timeout)

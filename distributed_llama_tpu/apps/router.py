@@ -50,12 +50,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-try socket timeout (connect and each read)")
     p.add_argument("--seed", type=int, default=0,
                    help="random-routing RNG seed (A/B reproducibility)")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="record router spans (proxy tries, stamped with each "
+                        "request's W3C trace id) and write a Chrome trace at "
+                        "exit; also enables GET /v1/trace — the fleet-merged "
+                        "Perfetto file joining this router's spans with every "
+                        "replica's (docs/OBSERVABILITY.md)")
     return p
 
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     faults.install_from_env()  # DLLAMA_FAULTS chaos config (resilience/)
+    tracer = None
+    if args.trace:
+        from ..obs import trace as obs_trace
+
+        tracer = obs_trace.install(process_name="router")
     server = serve_router(
         args.replicas, host=args.host, port=args.port, policy=args.routing,
         poll_interval=args.poll_interval, poll_timeout=args.poll_timeout,
@@ -78,6 +89,10 @@ def main(argv=None) -> None:
         pass
     finally:
         close_router(server)
+        if tracer is not None:
+            tracer.dump(args.trace)
+            print(f"🧭 wrote {len(tracer.events())} router trace events to "
+                  f"{args.trace}")
         print("🔴 router stopped")
 
 

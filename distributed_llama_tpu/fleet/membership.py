@@ -60,6 +60,13 @@ class Replica:
     free_slots: int = 0
     queue_depth: int = 0
     inflight: int = 0
+    # process identity from the replica's healthz block: pid matches the
+    # os_pid recorded per process in the fleet-merged Perfetto trace's
+    # otherData.processes (merged events themselves carry remapped index
+    # pids); a shrinking uptime between polls means the replica restarted
+    # (crash loop) even if every poll happened to land on a healthy window
+    pid: int = 0
+    uptime_s: float = 0.0
     consecutive_failures: int = 0
     last_ok: float = 0.0
     hash_warned: bool = False  # rate-limits the model-mismatch warning
@@ -79,7 +86,8 @@ class Replica:
                 "draining": self.draining, "status": self.status,
                 "model_hash": self.model_hash, "slots": self.slots,
                 "free_slots": self.free_slots,
-                "queue_depth": self.queue_depth, "inflight": self.inflight}
+                "queue_depth": self.queue_depth, "inflight": self.inflight,
+                "pid": self.pid, "uptime_s": self.uptime_s}
 
 
 def parse_addr(addr: str) -> tuple[str, int]:
@@ -161,6 +169,12 @@ class Membership:
         rep.free_slots = int(block.get("free_slots", rep.free_slots) or 0)
         rep.queue_depth = int(block.get("queue_depth", rep.queue_depth) or 0)
         rep.model_hash = block.get("model_hash", rep.model_hash)
+        prev_uptime = rep.uptime_s
+        rep.pid = int(block.get("pid", rep.pid) or 0)
+        rep.uptime_s = float(block.get("uptime_s", rep.uptime_s) or 0.0)
+        if prev_uptime and rep.uptime_s and rep.uptime_s < prev_uptime:
+            print(f"⚠️  replica {rep.id} restarted between polls "
+                  f"(uptime {prev_uptime:.0f}s -> {rep.uptime_s:.0f}s)")
         if rep.healthy:
             rep.consecutive_failures = 0
             rep.last_ok = time.monotonic()

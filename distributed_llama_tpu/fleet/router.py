@@ -37,13 +37,16 @@ import threading
 import time
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
-from ..obs import metrics
+from ..obs import metrics, reqctx, trace
+from ..obs.process import install_process_metrics
 from ..resilience import faults
 from .affinity import AffinityMap
 from .membership import Membership, Replica
 
-__all__ = ["RouterState", "serve_router", "close_router", "merge_prometheus"]
+__all__ = ["RouterState", "serve_router", "close_router", "merge_prometheus",
+           "fleet_trace"]
 
 _ROUTES = metrics.counter(
     "router_routes_total",
@@ -68,7 +71,8 @@ _PROXY_SECONDS = metrics.histogram(
     "router_proxy_seconds", "Per-try proxy wall time (successful tries)")
 
 _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
-                 "/v1/stats", "/metrics", "/health", "/healthz")
+                 "/v1/stats", "/metrics", "/health", "/healthz", "/v1/trace",
+                 "/v1/requests")
 
 
 class RouterState:
@@ -280,6 +284,35 @@ def fleet_stats(state: RouterState) -> dict:
     return out
 
 
+def fleet_trace(state: RouterState) -> dict:
+    """GET /v1/trace: ONE Perfetto-loadable Chrome trace for the whole fleet
+    — the router's own proxy spans plus every replica's `/v1/trace` export,
+    merged onto a wall-clock-aligned timeline with one pid (and a
+    process_name label) per process. A request's `router.proxy` span and its
+    replica-side engine spans share the `trace_id` arg the traceparent
+    propagation stamped, so following one request across processes is a
+    Perfetto args search (docs/OBSERVABILITY.md "Fleet trace merge")."""
+    sources: list[tuple[str, dict]] = []
+    own = trace.current()
+    if own is not None:
+        sources.append(("router", own.to_chrome_trace()))
+    for rep, res in _scrape_all(state, "/v1/trace"):
+        if isinstance(res, tuple):
+            status, body = res
+            if status == 200:
+                try:
+                    sources.append((f"replica {rep.id}", json.loads(body)))
+                    continue
+                except ValueError:
+                    pass  # a 200 with a non-JSON body IS a scrape error
+            elif status == 404:
+                # replica running without --trace: documented-normal — absent
+                # from the merge, never counted as a scrape failure
+                continue
+        _SCRAPE_ERRORS.inc()
+    return trace.merge_chrome_traces(sources)
+
+
 # ----------------------------------------------------------------------
 # HTTP handler
 # ----------------------------------------------------------------------
@@ -291,7 +324,10 @@ class RouterHandler(BaseHTTPRequestHandler):
         print(f"🔶 {self.command} {self.path}")
 
     def _count(self, code: int) -> None:
-        route = self.path if self.path in _KNOWN_ROUTES else "other"
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/v1/requests/"):
+            path = "/v1/requests"  # per-id lookups share one label value
+        route = path if path in _KNOWN_ROUTES else "other"
         _HTTP.labels(route=route, code=str(code)).inc()
 
     def _raw(self, code: int, content_type: str, data: bytes,
@@ -335,6 +371,69 @@ class RouterHandler(BaseHTTPRequestHandler):
                       fleet_metrics(state).encode())
         elif self.path == "/v1/stats":
             self._json(200, fleet_stats(state))
+        elif self.path == "/v1/trace":
+            self._json(200, fleet_trace(state))
+        elif self.path.startswith("/v1/requests/"):
+            # the slow-request workflow must work for clients that can only
+            # reach the router (replicas on an internal network): the id
+            # lives on exactly one replica's flight recorder — ask them all
+            # concurrently, relay the hit verbatim (replica 404s are the
+            # expected misses, never scrape errors)
+            path = self.path.split("?", 1)[0]
+            unreachable = 0
+            for rep, res in _scrape_all(state, path):
+                if isinstance(res, tuple):
+                    if res[0] == 200:
+                        self._raw(200, "application/json", res[1])
+                        return
+                    # a replica 404 is a definitive miss THERE; any other
+                    # status is indeterminate, like an exception below
+                    if res[0] == 404:
+                        continue
+                unreachable += 1
+                _SCRAPE_ERRORS.inc()
+            key = path[len("/v1/requests/"):]
+            if unreachable:
+                # a 404 here would claim the record doesn't exist anywhere
+                # while the replica that may hold it simply didn't answer
+                # (rolling restart) — report the uncertainty honestly
+                self._error(502, f"no flight record for {key!r} on the "
+                            f"replicas that answered, but {unreachable} "
+                            "replica(s) were unreachable", "server_error")
+            else:
+                self._error(404, f"no flight record for {key!r} on any "
+                            "replica", "invalid_request_error")
+        elif self.path.split("?", 1)[0] == "/v1/requests":
+            # merged listing: each replica's summaries nested under its id,
+            # query string (?slowest=K) validated HERE (a caller error must
+            # be a 400, not N replica 400s masquerading as scrape failures)
+            q = self.path.partition("?")[2]
+            try:
+                int(parse_qs(q).get("slowest", ["0"])[0])
+            except ValueError:
+                self._error(400, "'slowest' must be an integer",
+                            "invalid_request_error")
+                return
+            out: dict = {"replicas": {}}
+            for rep, res in _scrape_all(
+                    state, "/v1/requests" + (f"?{q}" if q else "")):
+                # same degradation contract as fleet_stats: a failing
+                # replica gets an explicit error entry, never a silent drop
+                if isinstance(res, tuple):
+                    status, body = res
+                    try:
+                        out["replicas"][rep.id] = (
+                            json.loads(body) if status == 200
+                            else {"error": f"status {status}"})
+                        continue
+                    except ValueError as e:
+                        _SCRAPE_ERRORS.inc()
+                        out["replicas"][rep.id] = {
+                            "error": f"non-JSON body: {e}"}
+                        continue
+                _SCRAPE_ERRORS.inc()
+                out["replicas"][rep.id] = {"error": repr(res)}
+            self._json(200, out)
         elif self.path == "/v1/models":
             rep = state.membership.least_loaded()
             if rep is None:
@@ -369,6 +468,12 @@ class RouterHandler(BaseHTTPRequestHandler):
             self._error(400, "Request body is not valid JSON",
                         "invalid_request_error")
             return
+        # trace origination (docs/OBSERVABILITY.md "Request tracing"): adopt
+        # the client's W3C traceparent or start a new trace; every proxy try
+        # is its own hop (fresh span id, same trace id) stamped onto the
+        # upstream request, so the replica's engine spans and this router's
+        # proxy span share one trace id in the merged fleet trace
+        ctx = reqctx.adopt(self.headers.get("traceparent"))
         key = state.affinity_key(body)
         tried: set[str] = set()
         last_503: tuple[bytes, str, str | None] | None = None
@@ -380,7 +485,12 @@ class RouterHandler(BaseHTTPRequestHandler):
             _ROUTES.labels(reason=reason).inc()
             if attempt == 1:
                 _RETRIES.inc()
-            outcome, info = self._proxy_try(rep, raw, key)
+            hop = ctx.child()
+            with reqctx.use(hop), \
+                    trace.span("router.proxy",
+                               {"replica": rep.id, "reason": reason,
+                                "attempt": attempt}):
+                outcome, info = self._proxy_try(rep, raw, key, hop)
             if outcome == "delivered" or outcome == "aborted":
                 return
             if info is not None:  # a relayable 503 from this replica
@@ -402,12 +512,17 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ proxy
 
-    def _proxy_try(self, rep: Replica, raw: bytes, key: bytes):
+    _RELAY_HEADERS = ("X-Request-Id", "X-Replica")
+
+    def _proxy_try(self, rep: Replica, raw: bytes, key: bytes, hop=None):
         """One proxy attempt against `rep`. Returns (outcome, relayable):
         outcome "delivered" (response fully relayed), "aborted" (failed
         after client bytes — already terminated, never retry), or "retry"
         (nothing reached the client; relayable = (body, ctype, retry_after)
-        when the failure was a replica 503 worth relaying)."""
+        when the failure was a replica 503 worth relaying). `hop` is this
+        try's trace context, stamped upstream as `traceparent`; the
+        replica's X-Request-Id/X-Replica response headers are relayed so
+        the client can reach GET /v1/requests/<id> on the serving replica."""
         state = self.state
         mem = state.membership
         mem.inflight_inc(rep)
@@ -417,10 +532,12 @@ class RouterHandler(BaseHTTPRequestHandler):
         try:
             try:
                 faults.fire("router.proxy", replica=rep.id)
+                headers = {"Content-Type": "application/json"}
+                if hop is not None:
+                    headers["traceparent"] = hop.to_traceparent()
                 conn = HTTPConnection(rep.host, rep.port,
                                       timeout=state.try_timeout)
-                conn.request("POST", self.path, raw,
-                             {"Content-Type": "application/json"})
+                conn.request("POST", self.path, raw, headers)
                 resp = conn.getresponse()
             except Exception:
                 _PROXY_ERRORS.labels(kind="connect").inc()
@@ -446,7 +563,9 @@ class RouterHandler(BaseHTTPRequestHandler):
             # 400/408 arrives here as plain JSON): relay verbatim, no retry
             # of non-503 errors (they are deterministic caller errors).
             data = resp.read()
-            self._raw(resp.status, ctype, data)
+            extra = {h: v for h in self._RELAY_HEADERS
+                     if (v := resp.getheader(h))}
+            self._raw(resp.status, ctype, data, extra or None)
             if resp.status == 200:
                 state.affinity.record(key, rep.id)
                 _PROXY_SECONDS.observe(time.perf_counter() - t0)
@@ -489,6 +608,10 @@ class RouterHandler(BaseHTTPRequestHandler):
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Transfer-Encoding", "chunked")
+                for h in self._RELAY_HEADERS:
+                    v = resp.getheader(h)
+                    if v:
+                        self.send_header(h, v)
                 self.end_headers()
                 self._count(200)
                 sent_any = True
@@ -531,6 +654,8 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
                    {"state": state, "protocol_version": "HTTP/1.1"})
     server = ThreadingHTTPServer((host, port), handler)
     server.router_state = state
+    install_process_metrics()  # uptime/RSS/threads/build info on /metrics
+    trace.set_process_name(f"router {host}:{server.server_address[1]}")
     print(f"🟢 fleet router listening on {host}:{server.server_address[1]} "
           f"({len(membership.replicas)} replicas, policy={policy})")
     return server

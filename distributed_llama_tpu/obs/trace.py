@@ -33,11 +33,15 @@ XLA device trace (perf/PROFILE.md workflow) under the same names.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
 
-__all__ = ["Tracer", "span", "instant", "install", "uninstall", "current"]
+from . import reqctx
+
+__all__ = ["Tracer", "span", "instant", "install", "uninstall", "current",
+           "set_process_name", "merge_chrome_traces"]
 
 
 class _NullSpan:
@@ -59,11 +63,22 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    """One live span: created by Tracer.span(), recorded at __exit__."""
+    """One live span: created by Tracer.span(), recorded at __exit__.
+
+    `tracer=None` makes the span MODULE-RESOLVED: it records through
+    whichever tracer is installed at exit time. Module-level span() uses
+    this so a tracer replaced mid-span (install() while spans are in
+    flight) receives the event instead of the orphaned predecessor's buffer
+    silently swallowing it. A span that ENTERED before the new tracer's
+    epoch records a negative ts — correct, not a bug: epochs and span
+    clocks read the same monotonic counter, so wall_start_unix + ts still
+    names the true absolute time (and merge_chrome_traces aligns on exactly
+    that anchor). Spans created via a Tracer instance directly stay bound
+    to that instance (tests own their tracer)."""
 
     __slots__ = ("_tracer", "name", "args", "_t0", "_annot")
 
-    def __init__(self, tracer: "Tracer", name: str, args: dict | None):
+    def __init__(self, tracer: "Tracer | None", name: str, args: dict | None):
         self._tracer = tracer
         self.name = name
         self.args = args
@@ -77,7 +92,8 @@ class _Span:
             self.args.update(args)
 
     def __enter__(self):
-        if self._tracer._annotate:
+        t = self._tracer if self._tracer is not None else _tracer
+        if t is not None and t._annotate:
             try:
                 import jax.profiler
 
@@ -92,7 +108,9 @@ class _Span:
         t1 = time.perf_counter_ns()
         if self._annot is not None:
             self._annot.__exit__(*exc)
-        self._tracer._record(self.name, self._t0, t1, self.args)
+        t = self._tracer if self._tracer is not None else _tracer
+        if t is not None:  # uninstalled mid-span: nowhere to record
+            t._record(self.name, self._t0, t1, self.args)
         return False
 
 
@@ -105,7 +123,8 @@ class Tracer:
     the child entered after and exited before on the same thread.
     """
 
-    def __init__(self, capacity: int = 65536, *, jax_annotations: bool = False):
+    def __init__(self, capacity: int = 65536, *, jax_annotations: bool = False,
+                 pid: int | None = None, process_name: str | None = None):
         assert capacity > 0
         self.capacity = capacity
         self._events: deque = deque(maxlen=capacity)
@@ -115,25 +134,49 @@ class Tracer:
         self._wall_start = time.time()
         self.dropped_events = 0
         self._thread_names: dict[int, str] = {}
+        # real process identity: every event used to hardcode pid 1, which
+        # made multi-process merge (fleet router + N replicas into one
+        # Perfetto file) impossible — identical pids folded every process
+        # onto one track. process_name labels the pid track in the viewer;
+        # servers set it once their bound address is known.
+        self.pid = os.getpid() if pid is None else pid
+        self.process_name = process_name
 
     # -- recording ------------------------------------------------------
 
     def span(self, name: str, args: dict | None = None) -> _Span:
         return _Span(self, name, args)
 
+    @staticmethod
+    def _stamp_trace(args: dict | None) -> dict | None:
+        """Stamp the active request context's trace id onto event args —
+        the engine-side half of distributed tracing: any span/instant
+        recorded while reqctx is bound carries the owning request's trace
+        id (searchable in Perfetto, joinable with the router's spans).
+        Runs only when a tracer IS installed, so the disabled path never
+        touches the contextvar."""
+        ctx = reqctx.current()
+        if ctx is None:
+            return args
+        args = dict(args) if args else {}
+        args.setdefault("trace_id", ctx.trace_id)
+        return args
+
     def instant(self, name: str, args: dict | None = None) -> None:
         """Point-in-time marker (Chrome "i" event)."""
         ts = (time.perf_counter_ns() - self._epoch_ns) / 1e3
+        args = self._stamp_trace(args)
         self._append({"name": name, "ph": "i", "ts": ts, "s": "t",
-                      "pid": 1, "tid": threading.get_ident(),
+                      "pid": self.pid, "tid": threading.get_ident(),
                       **({"args": args} if args else {})})
 
     def _record(self, name: str, t0_ns: int, t1_ns: int,
                 args: dict | None) -> None:
+        args = self._stamp_trace(args)
         ev = {"name": name, "ph": "X",
               "ts": (t0_ns - self._epoch_ns) / 1e3,  # Chrome wants microseconds
               "dur": (t1_ns - t0_ns) / 1e3,
-              "pid": 1, "tid": threading.get_ident()}
+              "pid": self.pid, "tid": threading.get_ident()}
         if args:
             ev["args"] = args
         self._append(ev)
@@ -150,22 +193,33 @@ class Tracer:
     # -- export ---------------------------------------------------------
 
     def events(self) -> list[dict]:
-        """Snapshot of buffered events (oldest first), plus thread metadata."""
+        """Snapshot of buffered events (oldest first), plus process/thread
+        metadata."""
         with self._lock:
             evs = list(self._events)
             names = dict(self._thread_names)
-        meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-                 "args": {"name": tname}} for tid, tname in sorted(names.items())]
+        meta = []
+        if self.process_name:
+            meta.append({"name": "process_name", "ph": "M", "pid": self.pid,
+                         "args": {"name": self.process_name}})
+        meta.extend({"name": "thread_name", "ph": "M", "pid": self.pid,
+                     "tid": tid, "args": {"name": tname}}
+                    for tid, tname in sorted(names.items()))
         return meta + evs
 
     def to_chrome_trace(self) -> dict:
-        """The Chrome trace-event JSON object (load in Perfetto as-is)."""
+        """The Chrome trace-event JSON object (load in Perfetto as-is).
+        `wall_start_unix` is the wall clock at the tracer's monotonic epoch —
+        the alignment anchor merge_chrome_traces() shifts each process's
+        timestamps by, so a fleet's traces share one timeline."""
         return {
             "traceEvents": self.events(),
             "displayTimeUnit": "ms",
             "otherData": {
                 "wall_start_unix": self._wall_start,
                 "dropped_events": self.dropped_events,
+                "pid": self.pid,
+                "process_name": self.process_name,
             },
         }
 
@@ -186,11 +240,15 @@ class Tracer:
 _tracer: Tracer | None = None
 
 
-def install(capacity: int = 65536, *, jax_annotations: bool = False) -> Tracer:
-    """Enable tracing process-wide; returns the tracer (idempotent: a second
-    install replaces the first — one tracer owns the buffer at a time)."""
+def install(capacity: int = 65536, *, jax_annotations: bool = False,
+            process_name: str | None = None) -> Tracer:
+    """Enable tracing process-wide; returns the tracer. A second install
+    replaces the first; module-level spans already in flight record through
+    the NEW tracer at exit (they resolve the installed tracer at record
+    time), so a replace can no longer strand events in an orphaned buffer."""
     global _tracer
-    _tracer = Tracer(capacity, jax_annotations=jax_annotations)
+    _tracer = Tracer(capacity, jax_annotations=jax_annotations,
+                     process_name=process_name)
     return _tracer
 
 
@@ -203,6 +261,14 @@ def current() -> Tracer | None:
     return _tracer
 
 
+def set_process_name(name: str) -> None:
+    """Label the installed tracer's process track (servers call this once
+    the bound host:port is known); no-op while tracing is disabled."""
+    t = _tracer
+    if t is not None:
+        t.process_name = name
+
+
 def span(name: str, args: dict | None = None):
     """`with span("engine.decode", {"t": 1}):` — no-op unless install()ed.
 
@@ -210,13 +276,72 @@ def span(name: str, args: dict | None = None):
     does not even build a dict per call site when the caller pre-builds
     nothing; callers that want rich args construct the dict inline, paying
     for it only at sites they chose to annotate."""
-    t = _tracer
-    if t is None:
+    if _tracer is None:
         return _NULL_SPAN
-    return t.span(name, args)
+    # tracer=None: module-resolved — records through whichever tracer is
+    # installed when the span exits (see _Span docstring)
+    return _Span(None, name, args)
 
 
 def instant(name: str, args: dict | None = None) -> None:
     t = _tracer
     if t is not None:
         t.instant(name, args)
+
+
+# ----------------------------------------------------------------------
+# fleet merge
+# ----------------------------------------------------------------------
+
+def merge_chrome_traces(sources: list[tuple[str, dict]]) -> dict:
+    """Merge per-process Chrome traces into ONE Perfetto-loadable document.
+
+    `sources` is [(process label, to_chrome_trace() dict)] — e.g. the fleet
+    router's own trace plus every replica's `GET /v1/trace` body. Each
+    source gets a distinct pid (its index, so traces from different HOSTS
+    with colliding OS pids still separate) labeled with a process_name
+    metadata event, and its timestamps are shifted by the difference of the
+    sources' `wall_start_unix` anchors onto the EARLIEST process's timeline
+    — per-process clocks are monotonic, so after the one wall-clock
+    alignment a request's router span and its replica spans sit in true
+    temporal order (NTP skew between hosts bounds the residual error).
+    `dropped_events` is summed; per-source drop counts are preserved in
+    `otherData.processes`."""
+    docs = [(label, doc) for label, doc in sources if doc]
+    walls = [float((doc.get("otherData") or {}).get("wall_start_unix") or 0.0)
+             for _label, doc in docs]
+    base = min((w for w in walls if w), default=0.0)
+    events: list[dict] = []
+    processes = []
+    dropped = 0
+    for idx, ((label, doc), wall) in enumerate(zip(docs, walls), start=1):
+        off_us = ((wall - base) * 1e6) if wall and base else 0.0
+        events.append({"name": "process_name", "ph": "M", "pid": idx,
+                       "args": {"name": label}})
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                continue  # replaced by the merge's own label above
+            ev = dict(ev)
+            ev["pid"] = idx
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + off_us
+            events.append(ev)
+        src_dropped = int((doc.get("otherData") or {}).get("dropped_events")
+                          or 0)
+        dropped += src_dropped
+        processes.append({"pid": idx, "name": label,
+                          # the source process's real OS pid (the one its
+                          # /metrics dllama_process_pid reports) — merged
+                          # events carry the index pid, this is the join key
+                          "os_pid": (doc.get("otherData") or {}).get("pid"),
+                          "wall_start_unix": wall or None,
+                          "dropped_events": src_dropped})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "wall_start_unix": base or None,
+            "dropped_events": dropped,
+            "processes": processes,
+        },
+    }

@@ -42,7 +42,7 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-from ..obs import metrics
+from ..obs import flight, metrics
 from .errors import FaultInjected, TransientDispatchError
 
 __all__ = ["KINDS", "FaultSpec", "FaultPlan", "fire", "install", "uninstall",
@@ -109,6 +109,12 @@ class FaultPlan:
                     continue
                 spec.fired += 1
             _INJECTED.labels(point=point, kind=spec.kind).inc()
+            # flight-recorder timeline hook: when the injection point fires
+            # inside a request's bound trace context (per-request points:
+            # prefill-of-slot, emit, cache-seed, api entry), the injected
+            # fault lands on THAT request's timeline — a chaos run's victim
+            # explains itself at GET /v1/requests/<id>
+            flight.note_fault(point, spec.kind)
             if spec.kind == "latency":
                 time.sleep(spec.delay_ms / 1000.0)
                 continue  # a latency spike doesn't shadow later error specs

@@ -58,9 +58,11 @@ Design:
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -68,7 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.spec import ModelSpec
-from ..obs import metrics, trace
+from ..obs import flight, metrics, reqctx, trace
 from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
                                  EngineDraining, EngineSaturated, classify)
@@ -181,6 +183,13 @@ class BatchRequest:
 
     cancelled: bool = False
     submit_t: float = 0.0  # perf_counter at submit(), feeds batch_queue_wait
+    # request identity (docs/OBSERVABILITY.md "Request tracing"): `rid` keys
+    # the flight-recorder timeline; `ctx` is the W3C trace context captured
+    # at submit() — the scheduler thread re-enters it (reqctx.use) around
+    # per-request work so engine-side spans/events carry this request's
+    # trace id even though one super-step serves many requests
+    rid: str = ""
+    ctx: object = None  # obs.reqctx.TraceContext | None
     # absolute perf_counter deadline for the WHOLE request (queue + decode);
     # 0 = none. The scheduler enforces it once per loop pass (finish reason
     # "deadline"), so granularity is one dispatch (~K token-times).
@@ -373,12 +382,16 @@ class BatchEngine:
 
     def submit(self, prompt: list[int], max_tokens: int, sampler,
                on_token=None, stop_check=None, *, deadline: float | None = None,
-               ttl: float | None = None) -> BatchRequest:
+               ttl: float | None = None, rid: str | None = None,
+               ctx=None) -> BatchRequest:
         """Enqueue a request. `deadline` (seconds) bounds the WHOLE request
         (queue + generation; finish reason "deadline", partial output kept);
         `ttl` bounds queue wait only (overrides the engine's queue_ttl).
-        Raises EngineDraining/EngineClosed during shutdown and
-        EngineSaturated when the wait queue is at max_queue."""
+        `rid`/`ctx` set the request id and trace context; both default from
+        the caller's bound reqctx (api_server's handler thread) or are
+        originated here, so every request is traceable even when submitted
+        outside the HTTP layer. Raises EngineDraining/EngineClosed during
+        shutdown and EngineSaturated when the wait queue is at max_queue."""
         if self._draining and not self._shutdown:
             raise EngineDraining(
                 "BatchEngine is draining (serving in-flight requests only)")
@@ -396,6 +409,23 @@ class BatchEngine:
         req = BatchRequest(list(prompt), max_tokens, sampler, on_token, stop_check)
         if not req.prompt:
             req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
+        # request identity: adopt the caller's trace context (the HTTP
+        # handler thread's contextvar) or originate one, and make the
+        # context carry the request id so the faults.fire → flight hook can
+        # attribute injections fired inside this request's scheduler scope
+        c = ctx if ctx is not None else reqctx.current()
+        rid = rid or (c.request_id if c is not None and c.request_id else "")
+        if not rid:
+            rid = f"req-{uuid.uuid4().hex[:16]}"
+        req.rid = rid
+        if c is None:
+            req.ctx = reqctx.new_context(rid)
+        elif c.request_id != rid:
+            req.ctx = dataclasses.replace(c, request_id=rid)
+        else:
+            req.ctx = c
+        flight.start(rid, req.ctx.trace_id, prompt_tokens=len(req.prompt),
+                     max_tokens=max_tokens)
         req.submit_t = time.perf_counter()
         if deadline is not None and deadline > 0:
             req.deadline_t = req.submit_t + deadline
@@ -494,6 +524,7 @@ class BatchEngine:
                     req.error = err
                     s.req = None
                     s.pending = []
+                    flight.finish(req.rid, "error", error=repr(err))
                     req.done.set()
             while True:
                 try:
@@ -502,6 +533,7 @@ class BatchEngine:
                     break
             for req in self._pending:
                 req.error = err
+                flight.finish(req.rid, "error", error=repr(err))
                 req.done.set()
             self._pending.clear()
 
@@ -534,12 +566,17 @@ class BatchEngine:
                 n += 1
             return min(n, len(req.prompt) - 1)
         best = max(free, key=common)
-        reuse = common(best)
+        rewind = common(best)
+        reuse = rewind
         if self.prefix_cache is not None:
             # [0, reuse) is served by the slot's own resident rows; anything
-            # the radix seed adds on top is counted as hit_tokens inside
+            # the radix seed adds on top is counted as hit_tokens inside.
+            # Cross-thread trace re-entry: the seed runs on the scheduler
+            # thread but belongs to THIS request — bind its context so the
+            # batch.prefix_seed span carries the request's trace id.
             self.prefix_cache.note_resident(reuse)
-            reuse = self._seed_from_cache(best, req, reuse)
+            with reqctx.use(req.ctx):
+                reuse = self._seed_from_cache(best, req, reuse)
         best.admit_t = time.monotonic()  # before .req: the watchdog keys on req
         best.req = req
         best.pos = reuse
@@ -550,8 +587,13 @@ class BatchEngine:
         best.clamp_pos = None
         best.armed = False
         req.stats.prompt_tokens = len(req.prompt)
+        qw_ms = ((time.perf_counter() - req.submit_t) * 1e3
+                 if req.submit_t else 0.0)
         if req.submit_t:
-            _QUEUE_WAIT.observe(time.perf_counter() - req.submit_t)
+            _QUEUE_WAIT.observe(qw_ms / 1e3)
+        flight.event(req.rid, "admitted", slot=best.index,
+                     queue_wait_ms=round(qw_ms, 3), rewind_tokens=rewind,
+                     seeded_tokens=reuse - rewind)
         return best
 
     def _seed_from_cache(self, slot: _Slot, req: BatchRequest,
@@ -630,6 +672,13 @@ class BatchEngine:
                 _ENGINE_ERRORS.labels(kind="transient").inc()
                 _RETRIES.inc()
                 attempt += 1
+                # the retry stalls every in-flight request equally: each
+                # timeline records it (the co-batched blast radius of a
+                # transient, made visible per request)
+                for s in self._slots:
+                    if s.req is not None:
+                        flight.event(s.req.rid, "dispatch_retry",
+                                     kind=kind, attempt=attempt)
                 time.sleep(min(delay, 1.0))
                 delay *= 2
 
@@ -656,6 +705,13 @@ class BatchEngine:
     def _finish(self, slot: _Slot, finish: str) -> None:
         req = slot.req
         req.finish = finish
+        # engine-side completion: the api layer (when there is one) adds
+        # TTFT/E2E to the same record after its own _observe_done; `error`
+        # only when real — its presence marks the record slow-log-eligible
+        flight.finish(req.rid, finish,
+                      generated_tokens=req.stats.generated_tokens,
+                      **({"error": repr(req.error)}
+                         if req.error is not None else {}))
         slot.req = None
         slot.pending = []
         slot.next_token = None
@@ -758,6 +814,7 @@ class BatchEngine:
                     f"request expired in queue ({expired_by})")
                 _DEADLINE_EXPIRED.labels(where="queue").inc()
                 _REQUESTS.labels(finish="deadline").inc()
+                flight.finish(req.rid, "deadline", expired_by=expired_by)
                 req.done.set()
             self._pending[:] = kept
             while self._pending:
@@ -765,6 +822,7 @@ class BatchEngine:
                     req = self._pending.pop(0)
                     req.finish = "cancelled"
                     _REQUESTS.labels(finish="cancelled").inc()
+                    flight.finish(req.rid, "cancelled")
                     req.done.set()
                     continue
                 try:
@@ -907,24 +965,28 @@ class BatchEngine:
         """Deliver one sampled token to the request (output list, stats,
         on_token stream) and run the host-side finish checks. Returns False
         when the request finished (slot released). slot.pos must already count
-        the ingestion of this token's input."""
+        the ingestion of this token's input. Runs under the request's trace
+        context: a fault injected at batch.emit (or a broken callback) lands
+        on the right flight-recorder timeline."""
         req = slot.req
-        # per-request delivery fault point: fires inside the same try blocks
-        # that guard a broken sampler/on_token callback, so an injected error
-        # here kills exactly one co-batched request (tests/test_resilience.py)
-        faults.fire("batch.emit", slot=slot.index, n_out=len(req.out))
-        req.out.append(token)
-        req.stats.generated_tokens += 1
-        _DECODE_TOKENS.inc()
-        if req.on_token is not None:
-            req.on_token(token)
-        if req.stop_check is not None and req.stop_check(token):
-            self._finish(slot, "stop")
-            return False
-        if len(req.out) >= req.max_tokens or slot.pos >= self.spec.seq_len:
-            self._finish(slot, "length")
-            return False
-        return True
+        with reqctx.use(req.ctx):
+            # per-request delivery fault point: fires inside the same try
+            # blocks that guard a broken sampler/on_token callback, so an
+            # injected error here kills exactly one co-batched request
+            # (tests/test_resilience.py)
+            faults.fire("batch.emit", slot=slot.index, n_out=len(req.out))
+            req.out.append(token)
+            req.stats.generated_tokens += 1
+            _DECODE_TOKENS.inc()
+            if req.on_token is not None:
+                req.on_token(token)
+            if req.stop_check is not None and req.stop_check(token):
+                self._finish(slot, "stop")
+                return False
+            if len(req.out) >= req.max_tokens or slot.pos >= self.spec.seq_len:
+                self._finish(slot, "length")
+                return False
+            return True
 
     def _advance_row(self, slot: _Slot) -> bool:
         """Ensure slot.last_token holds the row's next un-ingested token —
@@ -968,8 +1030,11 @@ class BatchEngine:
     def _prefill_step(self, slot: _Slot, riders: list[_Slot] = ()) -> None:
         # request-scope injection point: fires BEFORE the rider advance and
         # the device dispatch, so an injected error is attributable to the
-        # prefilling request alone (_loop_once fails only it)
-        faults.fire("batch.prefill", slot=slot.index, pending=len(slot.pending))
+        # prefilling request alone (_loop_once fails only it); bound to the
+        # request's trace context for timeline attribution
+        with reqctx.use(slot.req.ctx):
+            faults.fire("batch.prefill", slot=slot.index,
+                        pending=len(slot.pending))
         t0 = time.perf_counter()
         s = self.spec.seq_len
         room = s - slot.pos
@@ -1002,13 +1067,18 @@ class BatchEngine:
             # overwrite (in-bounds by the chunk shrink above)
             starts[r.index] = r.pos
             rows[r.index] = [r.last_token] + [0] * (t - 1)
-        with trace.span("batch.mixed_step" if riders else "batch.prefill",
-                        {"chunk": t, "riders": len(riders)}):
+        # the dispatch belongs to the prefilling request: bind its context
+        # so the span (and any dispatch fault) carries its trace id
+        with reqctx.use(slot.req.ctx), \
+                trace.span("batch.mixed_step" if riders else "batch.prefill",
+                           {"chunk": t, "riders": len(riders)}):
             logits = self._step(rows, starts, t,
                                 kind="mixed" if riders else "prefill")
         if riders:
             self.mixed_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1000.0
+        flight.event(slot.req.rid, "prefill_chunk", chunk=t,
+                     riders=len(riders), ms=round(dt_ms, 3))
         (_DISP_MIXED if riders else _DISP_PREFILL).observe(dt_ms / 1000.0)
         _PREFILL_TOKENS.inc(t)
         # rows neither prefilling nor riding spent this dispatch parked
@@ -1291,6 +1361,7 @@ class BatchEngine:
                 # reaped (cancel/deadline/close) between issue and delivery:
                 # the block was decoded past a frontier that no longer exists
                 _ROLLBACK_TOKENS.inc(b)
+                flight.event(req.rid, "rollback", tokens=b, where="reaped")
                 status[i] = "cancelled"
                 continue
             if not self._advance_row(slot):
@@ -1308,6 +1379,7 @@ class BatchEngine:
                 # loop below, and that _finish's harvest must not commit the
                 # poisoned row (_harvest_into_cache consumes clamp_pos)
                 slot.clamp_pos = s - 1
+                flight.event(req.rid, "park_clamped", pos=s - 1)
             block = toks[:b, i].tolist()
             smp = req.sampler
             state0 = int(getattr(smp, "state", 0))
@@ -1346,6 +1418,8 @@ class BatchEngine:
                 # the host delivered fewer (stop/cancel/error mid-block) — the
                 # tail sits on masked slots and is discarded
                 _ROLLBACK_TOKENS.inc(b - delivered)
+                flight.event(req.rid, "rollback", tokens=b - delivered,
+                             where="mid_block")
             if fl.temps[i] != 0.0 and hasattr(smp, "state"):
                 # resync the host sampler to the coins actually DELIVERED, not
                 # the full budget the device drew: a stop/cancel mid-block
@@ -1374,6 +1448,18 @@ class BatchEngine:
                 # the _park_positions clamp, incl. the lease shrink
                 self._truncate_history(slot, slot.clamp_pos)
                 slot.clamp_pos = None
+            # per-row timeline + trace attribution: one super_step entry per
+            # request it advanced, and (tracing on) a per-row instant bound
+            # to the request's context so the event carries ITS trace id —
+            # the cross-thread re-entry that makes one shared dispatch
+            # attributable per request in the merged fleet trace
+            flight.event(req.rid, "super_step", k=k, budget=b,
+                         delivered=delivered, chained=fl.chained)
+            if trace.current() is not None:
+                with reqctx.use(req.ctx):
+                    trace.instant("batch.row_delivered",
+                                  {"slot": i, "delivered": delivered,
+                                   "k": k})
             status[i] = "alive" if alive else req.finish
         return status
 
@@ -1400,3 +1486,6 @@ class BatchEngine:
         for discarded tokens)."""
         _PIPELINE_FLUSHES.labels(reason=reason).inc()
         _ROLLBACK_TOKENS.inc(sum(fl.budget))
+        for slot, req in fl.rows:
+            flight.event(req.rid, "pipeline_flush", reason=reason,
+                         tokens=fl.budget[slot.index])

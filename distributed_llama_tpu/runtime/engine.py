@@ -26,7 +26,7 @@ import numpy as np
 
 from ..models.params import Params, decode_stream_bytes, prepare_for_pallas
 from ..models.spec import ModelSpec
-from ..obs import metrics, trace
+from ..obs import flight, metrics, trace
 from ..resilience import faults
 from ..ops.rope import RopeTables
 from ..parallel.mesh import AXIS_TP, make_mesh
@@ -568,8 +568,14 @@ class Engine:
                         i += chunk
                         break
         _PREFILL_TOKENS.inc(len(tokens))
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        # flight-recorder timeline entry for the sequential serving path
+        # (--batch 1): rid resolves from the caller's bound trace context
+        # (api_server handler thread), no-op outside a recorded request
+        flight.event(None, "prefill", tokens=len(tokens),
+                     ms=round(dt_ms, 3))
         if stats is not None:
-            stats.prefill_ms = (time.perf_counter() - t0) * 1000.0
+            stats.prefill_ms = dt_ms
             stats.prompt_tokens = len(tokens)
         return logits
 
@@ -734,6 +740,8 @@ class Engine:
             dt_full = (time.perf_counter() - t0) * 1000.0
             _DISP_LOOP.observe(dt_full / 1000.0)
             _DECODE_TOKENS.inc(len(tokens))
+            flight.event(None, "device_loop", chunk=chunk,
+                         emitted=len(tokens), ms=round(dt_full, 3))
             stats.dispatch_ms.append(dt_full)
             # the dispatch always computes a full `chunk` of tokens even when the
             # emitted tail is shorter — divide by the compiled chunk size so

@@ -1,15 +1,19 @@
 #!/usr/bin/env python
 """Microbench: the DISABLED observability hot path must cost <1% of a decode
-dispatch (ISSUE 2 acceptance gate for always-on instrumentation).
+dispatch (ISSUE 2 acceptance gate for always-on instrumentation; ISSUE 7
+extends the bundle with the request-tracing hooks).
 
-The per-dispatch instrumentation added to runtime/engine.py / batch_engine.py
-is exactly:
+The per-dispatch instrumentation on runtime/engine.py / batch_engine.py is
+exactly:
 
     1 disabled trace.span() (global check + shared no-op context manager)
     1 inline args dict build
     2 time.perf_counter() calls
     1 Histogram.observe() (bisect + lock + 3 adds)
     1 Counter.inc()
+    1 disabled flight.event() (global check; kwargs dict built at call site)
+    1 reqctx.use() enter/exit (contextvar set + reset — the scheduler's
+      per-request trace re-entry)
 
 This script times that exact bundle standalone, times a real T=1 decode
 dispatch of the tiny CI model shape on the current backend, and asserts
@@ -37,7 +41,7 @@ import numpy as np
 
 from distributed_llama_tpu.models.params import init_random_params
 from distributed_llama_tpu.models.spec import ArchType, ModelSpec
-from distributed_llama_tpu.obs import metrics, trace
+from distributed_llama_tpu.obs import flight, metrics, reqctx, trace
 from distributed_llama_tpu.parallel.mesh import make_mesh
 from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
                                                make_sharded_forward,
@@ -51,18 +55,23 @@ SMALL = dict(arch_type=ArchType.LLAMA, dim=512, hidden_dim=1408, n_layers=4,
 
 def bench_instrumentation_bundle(n: int = 200_000) -> float:
     """Seconds per disabled-path bundle (span + dict + 2 clocks + observe +
-    inc) — the marginal cost one decode dispatch now pays."""
+    inc + disabled flight event + trace-context re-entry) — the marginal
+    cost one decode dispatch now pays."""
     trace.uninstall()
+    flight.uninstall()
     hist = metrics.histogram("obs_overhead_bench_seconds", "bench-only")
     ctr = metrics.counter("obs_overhead_bench_total", "bench-only")
+    ctx = reqctx.new_context("req-bench")
     t_start = time.perf_counter()
     for i in range(n):
-        with trace.span("engine.dispatch", {"t": 1, "pos": i}):
-            pass
-        t0 = time.perf_counter()
-        dt = time.perf_counter() - t0
-        hist.observe(dt)
-        ctr.inc()
+        with reqctx.use(ctx):
+            with trace.span("engine.dispatch", {"t": 1, "pos": i}):
+                pass
+            t0 = time.perf_counter()
+            dt = time.perf_counter() - t0
+            hist.observe(dt)
+            ctr.inc()
+            flight.event("req-bench", "super_step", k=8, delivered=8)
     return (time.perf_counter() - t_start) / n
 
 

@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""Repo-wide syntax + dead-import smoke (wired into tier-1 via
+"""Repo-wide syntax + dead-import + metric-docs smoke (wired into tier-1 via
 tests/test_smoke_lint.py).
 
-Two passes over every .py file in the repo:
+Three passes:
 
-1. **compileall** — byte-compiles everything, so a syntax error in a
+1. **compileall** — byte-compiles every .py, so a syntax error in a
    rarely-imported app path (the class of defect that survives a test suite
    importing only what it tests) fails tier-1 instead of the first prod run.
 2. **dead-import lint** — pyflakes when available; otherwise a conservative
@@ -12,6 +12,12 @@ Two passes over every .py file in the repo:
    appears NOWHERE else in the file text (docstrings and `__all__` strings
    count as uses, `# noqa` on the import line opts out), so false positives
    are structurally impossible for any name the file mentions at all.
+3. **metric-docs drift lint** — statically collects every
+   `metrics.counter/gauge/histogram("name", ...)` registration in the
+   `distributed_llama_tpu` package and fails when any name is absent from
+   docs/OBSERVABILITY.md's inventory. The doc rotted silently once (PR 2's
+   inventory missed later additions until a reviewer diffed by hand); now a
+   metric cannot ship undocumented.
 
 Run directly (`python perf/smoke_lint.py`) for CI/git-hook use: exit 0 clean,
 1 with findings on stderr.
@@ -119,9 +125,71 @@ def check_dead_imports(files: list[str]) -> list[str]:
     return findings
 
 
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+_OBS_DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+
+def collect_metric_names(files: list[str] | None = None
+                         ) -> list[tuple[str, str]]:
+    """[(metric name, relpath)] for every literal-named
+    counter()/gauge()/histogram() registration inside the package.
+
+    Matches both the module conveniences (`metrics.counter("x", ...)`) and
+    registry methods (`REGISTRY.counter(...)`, `reg.gauge(...)`) by the
+    ATTRIBUTE name; bare-name calls (`counter(...)` after a from-import)
+    are matched by function name. Non-literal first arguments are skipped —
+    there are none today, and a dynamic name would need its own doc story
+    anyway. Scope is the package only: tests and perf register bench-only
+    scratch metrics that never reach a production /metrics."""
+    if files is None:
+        files = [f for f in repo_py_files()
+                 if os.path.relpath(f, REPO).startswith(
+                     "distributed_llama_tpu" + os.sep)]
+    out = []
+    for path in files:
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # the compile pass reports this
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name not in _METRIC_FACTORIES:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                              str):
+                out.append((first.value, os.path.relpath(path, REPO)))
+    return sorted(set(out))
+
+
+def check_metric_docs() -> list[str]:
+    """Every registered metric name must appear in docs/OBSERVABILITY.md —
+    as a DELIMITED token, not a substring: a bare `in` test would let a new
+    metric ride on any documented name it happens to prefix (e.g.
+    `prefix_cache_hit` passing via `prefix_cache_hit_tokens_total`)."""
+    try:
+        with open(_OBS_DOC, encoding="utf-8") as fh:
+            doc = fh.read()
+    except OSError:
+        return [f"{os.path.relpath(_OBS_DOC, REPO)}: missing — the metric "
+                "inventory has nowhere to live"]
+    return [f"{path}: metric '{name}' is not documented in "
+            "docs/OBSERVABILITY.md (add it to the inventory)"
+            for name, path in collect_metric_names()
+            if not re.search(r"(?<![A-Za-z0-9_])" + re.escape(name)
+                             + r"(?![A-Za-z0-9_])", doc)]
+
+
 def main() -> int:
     files = repo_py_files()
-    errors = check_compile(files) + check_dead_imports(files)
+    errors = (check_compile(files) + check_dead_imports(files)
+              + check_metric_docs())
     for e in errors:
         print(e, file=sys.stderr)
     print(f"smoke_lint: {len(files)} files, {len(errors)} finding(s)")

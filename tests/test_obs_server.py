@@ -228,6 +228,105 @@ def test_dllama_trace_flag(model_files, tmp_path, capsys):
     assert any(d["ts"] >= p["ts"] + p["dur"] for d in dispatches)
 
 
+def test_flight_recorder_endpoints(obs_server):
+    """Tentpole (ISSUE 7): a completion's id resolves to its full flight
+    timeline at GET /v1/requests/<id> (queue wait, prefill/super-steps,
+    finish reason, TTFT/E2E), the listing supports ?slowest=K, and unknown
+    ids 404 with an OpenAI-shaped error."""
+    r = _post(obs_server, "/v1/chat/completions",
+              {"messages": [{"role": "user", "content": "flight check"}],
+               "max_tokens": 8, "temperature": 0})
+    assert r.status == 200
+    rid = r.getheader("X-Request-Id")
+    body = json.loads(r.read())
+    assert rid and body["id"] == rid  # completion id == flight key
+    assert r.getheader("X-Replica")  # serving replica identity
+
+    r = _get(obs_server, f"/v1/requests/{rid}")
+    assert r.status == 200
+    rec = json.loads(r.read())
+    assert rec["id"] == rid and len(rec["trace_id"]) == 32
+    assert rec["finish"] in ("length", "stop")
+    assert rec["e2e_ms"] > 0 and rec["ttft_ms"] is not None
+    assert rec["tokens"] == rec["generated_tokens"] == 8
+    names = [e["event"] for e in rec["events"]]
+    assert "admitted" in names, names
+    assert any(n in ("prefill_chunk", "super_step") for n in names), names
+    admitted = next(e for e in rec["events"] if e["event"] == "admitted")
+    assert admitted["queue_wait_ms"] >= 0
+    # timeline events are time-ordered offsets from request start
+    ts = [e["t_ms"] for e in rec["events"]]
+    assert ts == sorted(ts) and all(t >= 0 for t in ts)
+
+    # the same record is reachable by its trace id (merged-trace workflow)
+    r = _get(obs_server, f"/v1/requests/{rec['trace_id']}")
+    assert r.status == 200 and json.loads(r.read())["id"] == rid
+
+    # listing + slowest=K + bad query + unknown id
+    r = _get(obs_server, "/v1/requests")
+    assert r.status == 200
+    listing = json.loads(r.read())
+    assert any(s["id"] == rid for s in listing["completed"])
+    r = _get(obs_server, "/v1/requests?slowest=1")
+    assert r.status == 200 and len(json.loads(r.read())["completed"]) == 1
+    assert _get(obs_server, "/v1/requests?slowest=x").status == 400
+    r = _get(obs_server, "/v1/requests/chatcmpl-nonexistent")
+    assert r.status == 404
+    assert json.loads(r.read())["error"]["type"] == "invalid_request_error"
+
+
+def test_traceparent_adoption_and_trace_endpoint(obs_server):
+    """A client traceparent is adopted end-to-end: the flight record and the
+    engine-side spans carry the inbound trace id, and GET /v1/trace serves
+    the live Chrome trace (404 while tracing is disabled)."""
+    assert _get(obs_server, "/v1/trace").status == 404
+    tr = trace_mod.install(capacity=8192)
+    try:
+        tid = "ab" * 16
+        conn = http.client.HTTPConnection("127.0.0.1", obs_server, timeout=120)
+        conn.request("POST", "/v1/chat/completions",
+                     json.dumps({"messages": [{"role": "user",
+                                               "content": "traced request"}],
+                                 "max_tokens": 6, "temperature": 0}),
+                     {"Content-Type": "application/json",
+                      "traceparent": f"00-{tid}-{'12' * 8}-01"})
+        r = conn.getresponse()
+        assert r.status == 200
+        rid = r.getheader("X-Request-Id")
+        r.read()
+
+        rec = json.loads(_get(obs_server, f"/v1/requests/{rid}").read())
+        assert rec["trace_id"] == tid  # adopted, not re-originated
+
+        r = _get(obs_server, "/v1/trace")
+        assert r.status == 200
+        doc = json.loads(r.read())
+        assert doc["otherData"]["pid"] == tr.pid
+        stamped = [e for e in doc["traceEvents"]
+                   if (e.get("args") or {}).get("trace_id") == tid]
+        # scheduler-thread spans carry the request's trace id even though
+        # the dispatch is shared (cross-thread reqctx re-entry)
+        assert any(e["name"].startswith("batch.") for e in stamped), \
+            [e["name"] for e in doc["traceEvents"]][:20]
+    finally:
+        trace_mod.uninstall()
+
+
+def test_process_self_telemetry(obs_server):
+    """Satellite: uptime/RSS/threads/tracer-drops gauges and the build-info
+    gauge appear on /metrics with sane values."""
+    text = _get(obs_server, "/metrics").read().decode()
+    samples = _parse_prometheus(text)
+    assert samples["dllama_uptime_seconds"] > 0
+    assert samples["dllama_process_rss_bytes"] > 10 * 1024 * 1024
+    assert samples["dllama_threads"] >= 2  # main + scheduler at least
+    assert "dllama_tracer_dropped_events" in samples
+    assert samples["dllama_process_pid"] > 0
+    build = [k for k in samples if k.startswith("dllama_build_info{")]
+    assert len(build) == 1 and samples[build[0]] == 1
+    assert 'python="3.' in build[0] and "jax=" in build[0]
+
+
 def test_batch_trace_superstep_spans(model_files):
     """Tracing a BatchEngine run records super-step spans that do not overlap
     on the scheduler thread (the nesting/ordering the acceptance names)."""

@@ -45,6 +45,51 @@ def test_scan_covers_fleet_package():
     assert os.path.join("distributed_llama_tpu", "apps", "router.py") in rel
 
 
+def test_metric_names_documented():
+    """ISSUE 7 satellite: every metrics.counter/gauge/histogram name
+    registered anywhere in the package must appear in
+    docs/OBSERVABILITY.md — the metric inventory can no longer rot."""
+    undocumented = smoke_lint.check_metric_docs()
+    assert not undocumented, "\n".join(undocumented)
+
+
+def test_metric_collector_sees_known_registrations():
+    """The static collector actually finds the registrations the lint
+    guards: spot-check names from three different layers + the obs scan
+    covers the new modules."""
+    names = {n for n, _f in smoke_lint.collect_metric_names()}
+    for expected in ("batch_queue_wait_seconds", "api_request_ttft_seconds",
+                     "router_routes_total", "faults_injected_total",
+                     "dllama_uptime_seconds", "dllama_build_info"):
+        assert expected in names, (expected, sorted(names)[:10])
+    assert len(names) >= 60  # the real inventory, not a partial scan
+
+
+def test_metric_collector_flags_planted_metric(tmp_path):
+    """A metric registered in a scanned file but absent from the doc is
+    exactly what the lint exists to catch."""
+    mod = tmp_path / "planted.py"
+    mod.write_text(
+        "from distributed_llama_tpu.obs import metrics\n"
+        'M = metrics.counter("totally_undocumented_total", "x")\n'
+        'G = metrics.gauge(dynamic_name, "skipped: non-literal name")\n')
+    found = smoke_lint.collect_metric_names([str(mod)])
+    assert [n for n, _f in found] == ["totally_undocumented_total"]
+
+
+def test_metric_doc_match_is_token_delimited():
+    """A name that is merely a substring/prefix of documented text must NOT
+    pass — the lint matches delimited tokens, so `prefix_cache_hit` cannot
+    ride on `prefix_cache_hit_tokens_total`."""
+    import re
+
+    doc = open(smoke_lint._OBS_DOC, encoding="utf-8").read()
+    planted = "prefix_cache_hit"  # substring of a documented name
+    assert planted in doc  # the naive check would pass...
+    assert not re.search(r"(?<![A-Za-z0-9_])" + re.escape(planted)
+                         + r"(?![A-Za-z0-9_])", doc)  # ...the real one won't
+
+
 def test_fallback_checker_flags_planted_dead_import(tmp_path):
     """The AST fallback actually detects the defect class it exists for,
     and respects the noqa escape hatch."""
