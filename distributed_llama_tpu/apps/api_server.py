@@ -214,6 +214,18 @@ def _stats_payload(state: "ApiState") -> dict:
             "max_queue": be.max_queue,
             "queue_ttl": be.queue_ttl,
         }
+        if be.spec_k:
+            snap = metrics.snapshot()
+            drafted = snap.get("batch_spec_drafted_tokens_total", 0)
+            out["speculative"] = {
+                "k": be.spec_k,
+                "verify_steps": be.verify_steps,
+                "drafted_tokens": drafted,
+                "accepted_tokens": snap.get(
+                    "batch_spec_accepted_tokens_total", 0),
+                "accept_rate": (snap.get("batch_spec_accepted_tokens_total",
+                                         0) / drafted if drafted else None),
+            }
     elif state.engine is not None:
         eng = state.engine
         out["engine"] = {"pos": eng.pos, "tp": eng.tp, "sp": eng.sp,
@@ -667,11 +679,17 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           request_deadline: float = 0.0, flight_requests: int = 256,
           slow_log: str | None = None,
           slow_threshold: float = 1.0) -> ThreadingHTTPServer:
-    if batch_engine is not None and speculative_k > 0:
-        # guard EVERY caller, not just the CLI: the batch scheduler has no
-        # per-request verify dispatch, so the flag would be silently inert
-        raise ValueError("speculative_k requires batch_engine=None "
-                         "(continuous batching has no verify dispatch)")
+    # batched speculative decoding lives in the BatchEngine scheduler
+    # (construct it with speculative=K); speculative_k here drives only the
+    # sequential engine's per-request verify loop. Guard EVERY caller, not
+    # just the CLI: an engine built WITHOUT speculation plus speculative_k>0
+    # would otherwise be silently inert.
+    if (batch_engine is not None and speculative_k > 0
+            and not getattr(batch_engine, "spec_k", 0)):
+        raise ValueError(
+            "speculative_k > 0 with a batch_engine requires the engine to "
+            "be constructed with speculative=K (BatchEngine owns the "
+            "batched draft-verify path)")
     runner = batch_engine or engine
     state = ApiState(engine, template_type,
                      default_sampler or Sampler(runner.spec.vocab_size, 0.7, 0.9, 0),
@@ -841,10 +859,6 @@ def main(argv=None) -> None:
             p.error("--kv-cache-storage host|disc requires --batch 1: the "
                     "paged cache is single-sequence. For long-context serving "
                     "use --sp (more chips) or --batch 1.")
-        if args.speculative > 0:
-            p.error("--speculative requires --batch 1: the continuous-batching "
-                    "scheduler decodes all slots in one batched step and has "
-                    "no per-request verify dispatch.")
         import jax.numpy as jnp
 
         from ..runtime.batch_engine import BatchEngine
@@ -856,7 +870,7 @@ def main(argv=None) -> None:
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
             slots=args.batch, superstep=max(args.superstep, 1),
-            pipeline=args.pipeline,
+            pipeline=args.pipeline, speculative=args.speculative,
             prefix_cache=not args.no_prefix_cache,
             prefix_cache_blocks=args.prefix_cache_blocks,
             prefix_block_tokens=args.prefix_cache_block_tokens,
@@ -873,7 +887,9 @@ def main(argv=None) -> None:
         sampler = make_sampler(args, batch_engine.spec)
         print(f"⏩ Continuous batching: {args.batch} slots, "
               f"super-step K={batch_engine.superstep}, pipelined decode "
-              f"{'on' if batch_engine.pipeline else 'off'}")
+              f"{'on' if batch_engine.pipeline else 'off'}"
+              + (f", speculative k={batch_engine.spec_k}"
+                 if batch_engine.spec_k else ""))
     else:
         from .dllama import check_kv_storage
 

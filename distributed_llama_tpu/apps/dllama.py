@@ -106,10 +106,14 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                         "(runtime/device_loop.py); 0 = per-token host loop")
     p.add_argument("--speculative", type=int, default=0, metavar="K",
                    help="prompt-lookup speculative decoding: draft up to K "
-                        "tokens from context n-gram matches and verify them in "
-                        "one step (runtime/speculative.py). Greedy-only "
-                        "(temperature 0); emits exactly the sequential loop's "
-                        "tokens. No reference counterpart")
+                        "tokens from context n-gram matches and verify them "
+                        "in one step. Sequential mode (--batch 1, "
+                        "runtime/speculative.py) is greedy-only; with the "
+                        "api_server's --batch > 1 the BatchEngine verifies "
+                        "per-row draft blocks in one batched dispatch — "
+                        "greedy AND seeded-stochastic, token-identical "
+                        "either way (docs/SERVING.md \"Speculative "
+                        "decoding\"). No reference counterpart")
     p.add_argument("--trace", default=None, metavar="OUT.json",
                    help="record runtime spans (prefill chunks, decode "
                         "dispatches, super-steps, cold-attention callbacks) "

@@ -75,6 +75,7 @@ from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
                                  EngineDraining, EngineSaturated, classify)
 from .engine import PREFILL_CHUNKS, GenerationStats
+from .speculative import NgramIndex
 
 __all__ = ["BatchEngine", "BatchRequest"]
 
@@ -98,6 +99,7 @@ _DISP_PREFILL = _DISPATCH_SECONDS.labels(kind="prefill")
 _DISP_MIXED = _DISPATCH_SECONDS.labels(kind="mixed")
 _DISP_SINGLE = _DISPATCH_SECONDS.labels(kind="single_step")
 _DISP_SUPER = _DISPATCH_SECONDS.labels(kind="super_step")
+_DISP_VERIFY = _DISPATCH_SECONDS.labels(kind="verify")
 _SUPERSTEP_TOKENS = metrics.histogram(
     "batch_superstep_tokens",
     "Tokens decoded per super-step dispatch (sum of row budgets)",
@@ -163,8 +165,27 @@ _PIPELINE_FLUSHES = metrics.counter(
     "batch_pipeline_flushes_total",
     "Pipeline breaks by reason: an eagerly chained super-step was discarded "
     "before delivery (stop/cancel/error/finish — its rows diverged from the "
-    "speculated schedule) or chaining was declined (admission/close)",
+    "speculated schedule) or chaining was declined (admission/close, or "
+    "'spec': the accept-aware policy preferred a host-drafted verify "
+    "dispatch over extending the scan chain)",
     labelnames=("reason",))
+# Batched speculative decoding (docs/SERVING.md "Speculative decoding"):
+# per-engine spec telemetry next to the sequential path's spec_* family —
+# drafted/accepted volumes and the verify-dispatch count are THE health
+# signals for the batched draft-verify path (accept rate ~0 means the
+# workload is paying wide dispatches for nothing).
+_SPEC_VERIFY_STEPS = metrics.counter(
+    "batch_spec_verify_steps_total",
+    "Batched draft-verify super-step dispatches")
+_SPEC_DRAFTED = metrics.counter(
+    "batch_spec_drafted_tokens_total",
+    "Draft tokens proposed to batched verify dispatches (per row)")
+_SPEC_ACCEPTED = metrics.counter(
+    "batch_spec_accepted_tokens_total",
+    "Draft tokens batched verify dispatches accepted")
+_SPEC_ACCEPT_RATE = metrics.gauge(
+    "batch_spec_accept_rate",
+    "Cumulative batched accepted/drafted ratio (process lifetime)")
 
 
 @dataclass
@@ -242,10 +263,14 @@ class _Slot:
         # mid-loop _finish must harvest the TRUNCATED history, not the
         # poisoned row (consumed by _harvest_into_cache / the post-loop clamp)
         self.clamp_pos: int | None = None
+        # speculative drafting corpus (spec_k > 0): an NgramIndex over the
+        # request's prompt + emitted tokens, appended per delivered token —
+        # the per-slot proposer behind batched draft-verify super-steps
+        self.ngram: NgramIndex | None = None
 
 
 class _InflightStep:
-    """An issued-but-undelivered K-step super-step dispatch.
+    """An issued-but-undelivered K-step super-step OR draft-verify dispatch.
 
     Holds the DEVICE arrays the dispatch will produce (`toks` the (K, B)
     token block, plus the (last_tok, pos, rng) carry the next dispatch can
@@ -253,13 +278,20 @@ class _InflightStep:
     B-length `starts`/`budget`/`temps` lists plus the (slot, request) pairs
     of its live rows. A chained dispatch's schedule is SPECULATIVE — derived
     assuming its predecessor delivers every budgeted token — and is validated
-    against the predecessor's actual delivery before this dispatch is kept."""
+    against the predecessor's actual delivery before this dispatch is kept.
+
+    kind "verify" (docs/SERVING.md "Speculative decoding"): `toks` is the
+    (T, B) per-position target block, `ndraft` the per-row real draft counts
+    (-1 = parked), `acc` the device (B,) accepted lengths, and `budget` the
+    per-row MAXIMUM emit (ndraft+1) — delivery reads the actual emit, acc+1,
+    from the device. The carry is rewound to each row's verified frontier on
+    device, so a chained scan consumes it soundly for any accept outcome."""
 
     __slots__ = ("rows", "k", "starts", "budget", "temps", "toks", "tok",
-                 "pos", "rng", "t_issue", "chained")
+                 "pos", "rng", "t_issue", "chained", "kind", "ndraft", "acc")
 
     def __init__(self, rows, k, starts, budget, temps, toks, tok, pos, rng,
-                 t_issue, chained):
+                 t_issue, chained, kind="scan", ndraft=None, acc=None):
         self.rows = rows  # list[(slot, request)] for budget > 0 rows
         self.k = k
         self.starts = starts  # expected per-row device start positions
@@ -271,6 +303,9 @@ class _InflightStep:
         self.rng = rng  # device (B, 2) advanced xorshift* state
         self.t_issue = t_issue
         self.chained = chained
+        self.kind = kind  # "scan" | "verify"
+        self.ndraft = ndraft  # verify: per-row draft counts (-1 = parked)
+        self.acc = acc  # verify: device (B,) accepted draft lengths
 
 
 class BatchEngine:
@@ -286,7 +321,9 @@ class BatchEngine:
                  prefix_cache_blocks: int = 0, prefix_block_tokens: int = 16,
                  prefix_cache_q80: bool = False, max_queue: int = 0,
                  queue_ttl: float = 0.0, max_retries: int = 3,
-                 retry_backoff: float = 0.05, **engine_kw):
+                 retry_backoff: float = 0.05, speculative: int = 0,
+                 spec_min_draft: int = 1, spec_chain_expect: float = 2.0,
+                 **engine_kw):
         from .engine import Engine
 
         assert slots >= 1
@@ -321,9 +358,28 @@ class BatchEngine:
         # the scheduler thread is still finishing a long device step)
         self._pending: list[BatchRequest] = []
         self._plock = threading.Lock()
+        # Batched speculative decoding (docs/SERVING.md "Speculative
+        # decoding"): spec_k > 0 drafts up to k tokens per row from the
+        # slot's NgramIndex and verifies every row's block in ONE (B, 1+k)
+        # dispatch — the weights stream once for up to k+1 tokens per row.
+        # spec_min_draft gates a verify dispatch on total drafted tokens
+        # (below it the K-step scan serves better); spec_chain_expect is the
+        # accept-aware chaining threshold: while the engine's accept EMA is
+        # at/above it, back-to-back verifies beat diluting them with chained
+        # scans, so chaining is declined (reason "spec").
+        self.spec_k = max(int(speculative), 0)
+        if self.spec_k:
+            # a verify block must fit the context with room to decode
+            self.spec_k = min(self.spec_k, spec.seq_len - 2)
+        self.spec_min_draft = max(int(spec_min_draft), 1)
+        self.spec_chain_expect = float(spec_chain_expect)
+        # optimistic start: speculation engages immediately and the EMA
+        # adapts down on non-repetitive workloads (updated per verify)
+        self._spec_ema = float(self.spec_k)
         self.prefilled_tokens = 0  # observability: total tokens run through prefill
         self.decode_steps = 0  # observability: batched device decode dispatches
         self.super_steps = 0  # observability: K-step fused dispatches (subset)
+        self.verify_steps = 0  # observability: draft-verify dispatches (subset)
         self.mixed_steps = 0  # observability: prefill dispatches carrying decode rows
         self._loops: dict[tuple, object] = {}  # (k, mode, window) -> batched loop
         # scheduler wakeup: a Condition, not a sleep-poll — submit() notifies,
@@ -586,6 +642,9 @@ class BatchEngine:
         best.next_token = None
         best.clamp_pos = None
         best.armed = False
+        # drafting corpus: the FULL prompt (including any reused prefix) —
+        # prompt-lookup draws drafts from exactly that repetitive history
+        best.ngram = NgramIndex(req.prompt) if self.spec_k else None
         req.stats.prompt_tokens = len(req.prompt)
         qw_ms = ((time.perf_counter() - req.submit_t) * 1e3
                  if req.submit_t else 0.0)
@@ -715,6 +774,7 @@ class BatchEngine:
         slot.req = None
         slot.pending = []
         slot.next_token = None
+        slot.ngram = None
         if self.prefix_cache is not None and slot.lease is not None:
             # the lease pins blocks for the IN-FLIGHT period only; release
             # before done.set() so a caller observing completion sees no
@@ -976,6 +1036,8 @@ class BatchEngine:
             # (tests/test_resilience.py)
             faults.fire("batch.emit", slot=slot.index, n_out=len(req.out))
             req.out.append(token)
+            if slot.ngram is not None:  # corpus = prompt + delivered output
+                slot.ngram.append(token)
             req.stats.generated_tokens += 1
             _DECODE_TOKENS.inc()
             if req.on_token is not None:
@@ -1110,6 +1172,15 @@ class BatchEngine:
                 active.remove(slot)
         if not active:
             return
+        if self.spec_k:
+            # speculative path: draft per-row n-gram proposals; when any row
+            # has a draft worth verifying, spend this dispatch on a (B, T)
+            # verify block instead of the scan — one weight stream for up to
+            # T tokens per row. Empty drafts fall through to the scan.
+            plan = self._plan_verify(active)
+            if plan is not None:
+                self._verify_step(*plan)
+                return
         k = self.superstep
         if k > 1:
             with self._plock:
@@ -1162,6 +1233,159 @@ class BatchEngine:
                 moe_sharding=eng.moe_sharding,
                 fused_prologue=eng.fused_prologue)
         return self._loops[key]
+
+    def _verify_loop(self, t: int, mode: str, window: int | None):
+        """Compiled (B, T=t) draft-verify program for this engine's config
+        (one per (t, mode, window-bucket), memoized alongside the scans)."""
+        key = ("verify", t, mode, window)
+        if key not in self._loops:
+            from .device_loop import make_batched_verify_loop
+
+            eng = self._eng
+            self._loops[key] = make_batched_verify_loop(
+                self.spec, eng.mesh, eng.params, t, mode=mode, dtype=eng.dtype,
+                use_pallas=eng.use_pallas,
+                compress_collectives=eng.compress, donate_cache=True,
+                attn_window=window, cache_write=eng.cache_write,
+                moe_sharding=eng.moe_sharding,
+                fused_prologue=eng.fused_prologue)
+        return self._loops[key]
+
+    def _verify_block_for(self, t: int) -> int:
+        """Block-length bucket (2, 3, 5, 9, 17, ... capped at 1+spec_k):
+        verify programs compile per length, so raw per-dispatch lengths
+        would compile O(spec_k) programs; buckets bound it to O(log k).
+        Padding positions are scratch writes beyond the frontier — the same
+        masked-slot discipline every over-decode already relies on."""
+        cap = 1 + self.spec_k
+        b = 2
+        while b < t:
+            b = 2 * (b - 1) + 1
+        return min(b, cap)
+
+    def _plan_verify(self, active: list[_Slot]):
+        """Draft per-row proposals for one verify dispatch. Returns
+        (active, T, drafts) or None when no row drafted spec_min_draft
+        tokens (a draftless verify emits 1 token per row for a full-width
+        dispatch — the K-step scan serves that regime better). Caps mirror
+        the sequential loop (runtime/speculative.py): a row drafts at most
+        min(k, max_tokens-room, context-room) so emitting the full accepted
+        block never overruns max_tokens or the cache, and T shrinks so
+        every live row's T block writes stay inside seq_len."""
+        s = self.spec.seq_len
+        drafts: dict[int, list[int]] = {}
+        total = 0
+        max_pos = 0
+        for slot in active:
+            req = slot.req
+            cap = min(self.spec_k, req.max_tokens - len(req.out) - 1,
+                      s - slot.pos - 2)
+            d = (slot.ngram.propose_extended(cap)
+                 if (cap > 0 and slot.ngram) else [])
+            drafts[slot.index] = d
+            total += len(d)
+            max_pos = max(max_pos, slot.pos)
+        if total < self.spec_min_draft:
+            return None
+        t = self._verify_block_for(1 + max(len(d) for d in drafts.values()))
+        room = s - max_pos
+        if t > room:
+            # context-end shrink rounds DOWN to a bucket: per-length tail
+            # programs (t = room, room-1, ...) would mint O(k) fresh
+            # compiles exactly at the latency-critical end of long requests
+            b = 2
+            while b < t and 2 * (b - 1) + 1 <= room:
+                b = 2 * (b - 1) + 1
+            t = b if b <= room else 0
+        if t < 2:
+            return None
+        for d in drafts.values():
+            del d[t - 1:]  # context-end shrink may cut long drafts
+        return active, t, drafts
+
+    def _verify_step(self, active: list[_Slot], t: int,
+                     drafts: dict[int, list[int]]) -> None:
+        """One draft-verify super-step (docs/SERVING.md "Speculative
+        decoding"): every active row rides a (B, T) block — its pending
+        token plus its n-gram draft, padded — the device verifies all rows
+        in one forward (weights stream ONCE for up to T tokens per row) and
+        delivery emits each row's accepted prefix plus the correction/bonus
+        token. Rejected tails sit beyond the verified frontier on masked
+        slots (the free-rollback discipline); the device carry is rewound to
+        the frontier so a chained scan composes for any accept outcome."""
+        faults.fire("batch.verify", rows=len(active), block=t)
+        starts = self._park_positions(t)
+        ndraft = [-1] * self.slots_n  # -1 parks the row inside the block
+        props = [[0] * t for _ in range(self.slots_n)]
+        budget = [0] * self.slots_n  # per-row MAX emit (accept + correction)
+        rows: list[tuple[_Slot, BatchRequest]] = []
+        for slot in active:
+            i = slot.index
+            d = drafts.get(i, [])
+            starts[i] = slot.pos
+            props[i] = [slot.last_token] + d + [0] * (t - 1 - len(d))
+            ndraft[i] = len(d)
+            budget[i] = len(d) + 1
+            rows.append((slot, slot.req))
+        fl = self._issue_verify_step(rows, t, ndraft, props, budget, starts)
+        self._pipeline_advance(fl)
+
+    def _issue_verify_step(self, rows: list, t: int, ndraft: list[int],
+                           props: list[list[int]], budget: list[int],
+                           starts: list[int]) -> _InflightStep:
+        """Dispatch one (B, T) verify block asynchronously. Always uploads
+        host state (a verify is never chained FROM: its proposals are
+        host-drafted from delivered history), but its returned carry is
+        frontier-rewound on device, so successors may chain from IT."""
+        eng = self._eng
+        temps = [0.0] * self.slots_n
+        topps = [0.9] * self.slots_n
+        rng = np.zeros((self.slots_n, 2), np.uint32)
+        greedy = True
+        for slot, req in rows:
+            i = slot.index
+            smp = req.sampler
+            temps[i] = float(getattr(smp, "temperature", 0.0))
+            topps[i] = float(getattr(smp, "topp", 0.9))
+            greedy = greedy and temps[i] == 0.0
+            state = int(getattr(smp, "state", 0)) & ((1 << 64) - 1)
+            rng[i] = state >> 32, state & 0xFFFFFFFF
+        mode = "greedy" if greedy else "sample"
+        window = eng._window_for(min(max(starts) + t, self.spec.seq_len))
+        loop = self._verify_loop(t, mode, window)
+        if self._gap_t is not None:
+            _DISPATCH_GAP.observe(max(time.perf_counter() - self._gap_t, 0.0))
+        t_issue = time.perf_counter()
+        with trace.span("batch.verify_issue",
+                        {"block": t, "rows": len(rows),
+                         "drafted": sum(max(n, 0) for n in ndraft)}):
+            def call():
+                toks, acc, tok, pos, rng_out, eng.k_cache, eng.v_cache = loop(
+                    eng.params, eng.rope, props, eng.k_cache, eng.v_cache,
+                    starts, rng, temps, topps, ndraft)
+                return toks, acc, tok, pos, rng_out
+
+            toks, acc, tok, pos, rng_out = self._dispatched("verify", call)
+        _PIPELINE_DEPTH.set(1)
+        for a in (toks, acc, rng_out):
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass
+        return _InflightStep(rows, t, starts, budget, temps, toks, tok, pos,
+                             rng_out, t_issue, False, kind="verify",
+                             ndraft=ndraft, acc=acc)
+
+    def _drafts_ready(self, rows: list) -> bool:
+        """Cheap probe: would a verify dispatch have material to work with?
+        Consulted by the accept-aware chain policy BEFORE the in-flight
+        block delivers, so it sees the pre-block corpus — advisory only."""
+        for slot, _req in rows:
+            ng = slot.ngram
+            if ng is not None and len(ng.propose_extended(self.spec_k)) >= \
+                    self.spec_min_draft:
+                return True
+        return False
 
     def _super_step(self, active: list[_Slot], k: int,
                     budgets: dict[int, int]) -> None:
@@ -1229,15 +1453,41 @@ class BatchEngine:
         _PIPELINE_DEPTH.set(1 if self._inflight is not None else 0)
 
     def _plan_chain(self, fl: _InflightStep):
-        """Speculative schedule for the super-step after `fl`, assuming `fl`
-        delivers every budgeted token: same rows, re-derived budgets from the
-        expected positions/output lengths. Returns (rows, starts, budget,
-        clamp_slots), or None when no row would decode >= 2 steps (the
-        single-step / admission path takes over) or a reap is imminent."""
+        """Speculative schedule for the scan super-step after `fl`, assuming
+        `fl` delivers every budgeted token: same rows, re-derived budgets
+        from the expected positions/output lengths. Returns (rows, starts,
+        budget, clamp_slots), or None when no row would decode >= 2 steps
+        (the single-step / admission path takes over), a reap is imminent,
+        or the ACCEPT-AWARE policy declines (docs/SERVING.md "Speculative
+        decoding"): while the engine's accept EMA is at/above
+        spec_chain_expect, the next dispatch should be a host-drafted verify
+        block (which cannot chain — its proposals need delivered tokens),
+        not a K-step scan that would dilute it to ~1 token per step-cost.
+
+        A verify predecessor is planned against FULL acceptance — the
+        maximal positions/output lengths — so the derived budgets are sound
+        for ANY actual accept: the chained scan consumes the device carry,
+        which the verify loop rewound to the true frontier, and a row that
+        accepted less simply decodes with a conservative budget. Only a row
+        that FINISHED mid-verify (stop/length/cancel) flushes the chain,
+        exactly like the scan-after-scan divergence rule."""
         k = self.superstep
         s = self.spec.seq_len
         now = time.perf_counter()
-        starts = [st + b for st, b in zip(fl.starts, fl.budget)]
+        if fl.kind == "verify":
+            if self._spec_ema >= self.spec_chain_expect:
+                _PIPELINE_FLUSHES.labels(reason="spec").inc()
+                return None
+            gain = [nd + 1 if nd >= 0 else 0 for nd in fl.ndraft]
+        elif (self.spec_k and self._spec_ema >= self.spec_chain_expect
+              and self._drafts_ready(fl.rows)):
+            # extending the scan chain would outrun the verify those
+            # drafts are ready for — break it (flush reason "spec")
+            _PIPELINE_FLUSHES.labels(reason="spec").inc()
+            return None
+        else:
+            gain = fl.budget
+        starts = [st + g for st, g in zip(fl.starts, gain)]
         budget = [0] * self.slots_n
         rows: list[tuple[_Slot, BatchRequest]] = []
         clamp: list[_Slot] = []
@@ -1245,7 +1495,7 @@ class BatchEngine:
             i = slot.index
             if req.cancelled or (req.deadline_t and now >= req.deadline_t):
                 return None  # _reap_slots fires next pass: don't outrun it
-            exp_out = len(req.out) + fl.budget[i]
+            exp_out = len(req.out) + gain[i]
             b = min(k, req.max_tokens - exp_out, s - starts[i])
             if b > 0:
                 budget[i] = b
@@ -1329,9 +1579,11 @@ class BatchEngine:
         s = self.spec.seq_len
         with trace.span("batch.super_step", {"k": k, "rows": len(fl.rows),
                                              "tokens": sum(fl.budget),
+                                             "kind": fl.kind,
                                              "chained": fl.chained}):
             toks = np.asarray(fl.toks)  # (k, B): blocks until the device lands
             rng_out = np.asarray(fl.rng)
+            acc = np.asarray(fl.acc) if fl.kind == "verify" else None
         t_ready = time.perf_counter()
         self._last_dispatch_t = time.monotonic()
         # device-span estimate: the device could not start this dispatch
@@ -1347,16 +1599,26 @@ class BatchEngine:
         self._last_ready_t = t_ready
         self._gap_t = t_ready
         self.decode_steps += 1
-        self.super_steps += 1
-        _DISP_SUPER.observe(dev_ms / 1000.0)
+        if fl.kind == "verify":
+            self.verify_steps += 1
+            _SPEC_VERIFY_STEPS.inc()
+            _DISP_VERIFY.observe(dev_ms / 1000.0)
+        else:
+            self.super_steps += 1
+            _DISP_SUPER.observe(dev_ms / 1000.0)
         _SUPERSTEP_TOKENS.observe(sum(fl.budget))
         # rows that ride the scan without a live request park for all k steps;
         # rows with a short budget park for the steps past it
         _PARKED_ROW_STEPS.inc(self.slots_n * k - sum(fl.budget))
         status: dict[int, str] = {}
+        accs: list[int] = []  # per-row accepted lengths (verify EMA input)
         for slot, req in fl.rows:
             i = slot.index
             b = fl.budget[i]
+            if fl.kind == "verify":
+                # actual emit: accepted drafts + the correction/bonus token
+                # (fl.budget holds the maximum, ndraft+1)
+                b = int(acc[i]) + 1
             if slot.req is not req or req.done.is_set():
                 # reaped (cancel/deadline/close) between issue and delivery:
                 # the block was decoded past a frontier that no longer exists
@@ -1372,14 +1634,32 @@ class BatchEngine:
                 _ROLLBACK_TOKENS.inc(b)
                 status[i] = req.finish
                 continue
-            if b < k and fl.starts[i] + b >= s:
+            if fl.kind == "scan" and b < k and fl.starts[i] + b >= s:
                 # the scan parked this row mid-block clamped at s-1, whose
                 # scratch writes destroyed that history row — record it BEFORE
                 # delivery: reaching pos == s finishes the request inside the
                 # loop below, and that _finish's harvest must not commit the
                 # poisoned row (_harvest_into_cache consumes clamp_pos)
+                # (verify blocks never clamp a live row: _plan_verify shrinks
+                # T so every live row's block fits under seq_len)
                 slot.clamp_pos = s - 1
                 flight.event(req.rid, "park_clamped", pos=s - 1)
+            if fl.kind == "verify":
+                # per-request speculation accounting, recorded BEFORE the
+                # emit loop so spec_turns keys on the pre-block output length
+                # (the accept-length oracle in tests/test_batched_spec.py)
+                nd = fl.ndraft[i]
+                a = b - 1
+                accs.append(a)
+                req.stats.spec_steps += 1
+                req.stats.spec_drafted += nd
+                req.stats.spec_accepted += a
+                req.stats.spec_turns.append((len(req.out), nd, a))
+                req.stats.spec_step_ms.append(dev_ms)
+                _SPEC_DRAFTED.inc(nd)
+                _SPEC_ACCEPTED.inc(a)
+                flight.event(req.rid, "verify_step", block=k, drafted=nd,
+                             accepted=a)
             block = toks[:b, i].tolist()
             smp = req.sampler
             state0 = int(getattr(smp, "state", 0))
@@ -1461,6 +1741,24 @@ class BatchEngine:
                                   {"slot": i, "delivered": delivered,
                                    "k": k})
             status[i] = "alive" if alive else req.finish
+        if fl.kind == "verify":
+            if accs:
+                # accept EMA drives the chain policy: high expected accept →
+                # back-to-back verifies; low → chained scans keep overlap
+                self._spec_ema = (0.7 * self._spec_ema
+                                  + 0.3 * (sum(accs) / len(accs)))
+            if _SPEC_DRAFTED.value > 0:
+                _SPEC_ACCEPT_RATE.set(_SPEC_ACCEPTED.value
+                                      / _SPEC_DRAFTED.value)
+        elif self.spec_k:
+            # slow regression toward optimism while scans run: a decayed EMA
+            # must not disengage speculation FOREVER (verifies are the only
+            # signal that raises it) — after ~a dozen scans the policy
+            # re-probes with one verify and re-learns the true accept rate,
+            # bounding the waste on hopeless workloads to one wide dispatch
+            # per dozen scans while phase changes (output turning repetitive
+            # mid-stream) are picked up within the same horizon
+            self._spec_ema += 0.05 * (self.spec_k - self._spec_ema)
         return status
 
     def _chain_divergence(self, nxt: _InflightStep,

@@ -348,3 +348,137 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
         return toks, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc
 
     return run
+
+
+def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
+                             mode: str = "greedy", dtype=None,
+                             use_pallas: bool = False,
+                             compress_collectives: bool = False,
+                             donate_cache: bool = True,
+                             attn_window: int | None = None,
+                             cache_write: str = "inscan",
+                             moe_sharding: str = "slice",
+                             fused_prologue: bool = False):
+    """Batched draft-verify super-step: ONE (B, T=block) forward ingests each
+    row's proposal block and on-device acceptance turns it into up to T
+    tokens per row — the speculative-decoding counterpart of
+    make_batched_decode_loop (docs/SERVING.md "Speculative decoding").
+
+    Decode is HBM-bandwidth-bound: a T-token dispatch streams the quantized
+    weight blocks ONCE for all T positions, so verifying a k-token draft
+    costs roughly one decode step while delivering accept+1 tokens. Drafts
+    are host-side per-slot n-gram proposals (runtime/speculative.py); this
+    program verifies every row's block in one dispatch.
+
+    Builds fn(params, rope, proposals (B, T), kc, vc, start_pos (B,),
+    rng (B, 2) uint32 [hi, lo], temperature (B,), topp (B,), ndraft (B,)) ->
+    (targets (T, B), acc (B,), last_tok (B,), pos (B,), rng (B, 2), kc, vc).
+
+    Per row r: proposals[r] = [pending_token, draft_0..draft_{nd-1}, pad...]
+    with nd = ndraft[r] (-1 parks the row: its start_pos must already be
+    host-clamped a la _park_positions so all T scratch writes stay
+    in-cache). The forward writes the whole block's KV at start_pos..+T-1;
+    a target token is sampled at every position with the host Sampler's
+    semantics, and acc[r] counts the leading drafts whose target matched —
+    the standard speculative identity: emitted tokens are targets[0..acc],
+    where targets[acc] is the correction (first mismatch's own sample) or
+    the bonus token (full accept). Rejected positions hold KV computed from
+    rejected inputs, but they sit beyond the verified frontier pos+acc+1
+    where every read path masks them (the free-rollback discipline).
+
+    The (last_tok, pos, rng) trailer is rewound to the verified frontier ON
+    DEVICE: last_tok = targets[acc] (sampled, not yet ingested), pos =
+    start_pos + acc + 1, and rng the xorshift* state after exactly acc+1
+    coins for live stochastic rows (greedy rows draw none) — coin i of the
+    stream samples target i, so accepted-or-corrected tokens consume coins
+    in exactly the host Sampler's order and a chained scan dispatch
+    (runtime/batch_engine.py) can consume the carry for ANY accept outcome.
+    """
+    from ..parallel.mesh import AXIS_DP
+
+    assert mode in ("greedy", "sample"), mode
+    assert block >= 2, "a verify block needs at least one draft position"
+    dtype = dtype or jnp.float32
+    sp = mesh.shape.get(AXIS_SP, 1)
+    dp = mesh.shape.get(AXIS_DP, 1)
+    assert sp == 1, "batched verify needs per-row cache positions (no sp ring)"
+    param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
+    kv_spec = kv_cache_pspec_for_mesh(mesh)
+    rope_type = spec.rope_type
+
+    fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+                            sp_axis_name=None, sp_size=1, use_pallas=use_pallas,
+                            compress_collectives=compress_collectives,
+                            attn_window=attn_window, cache_write=cache_write,
+                            fused_prologue=fused_prologue)
+
+    def loop(p, rope_cos, rope_sin, proposals, kc, vc, start_pos, rng_hi,
+             rng_lo, temperature, topp, ndraft):
+        rope = RopeTables(rope_cos, rope_sin, rope_type)
+        b = proposals.shape[0]
+        live = ndraft >= 0  # (B,)
+        logits, kc, vc = fwd(p, rope=rope, tokens=proposals, k_cache=kc,
+                             v_cache=vc, start_pos=start_pos)
+        rows = logits.astype(jnp.float32)  # (B, T, vocab)
+        if mode == "greedy":
+            targets = jnp.argmax(rows, axis=-1).astype(jnp.int32)  # (B, T)
+        else:
+            # T coins per row in host-stream order: coin i (and the state
+            # after i+1 draws) samples the block's i-th emitted token
+            def draw(carry, _):
+                sh, sl = carry
+                nsh, nsl, coin = xorshift_coin(sh, sl)
+                return (nsh, nsl), (coin, nsh, nsl)
+
+            _, (coins, shs, sls) = jax.lax.scan(
+                draw, (rng_hi, rng_lo), None, length=block)
+            sample_row = jax.vmap(device_sample_coin,
+                                  in_axes=(0, 0, None, None))  # over T
+            targets = jax.vmap(sample_row, in_axes=(0, 1, 0, 0))(
+                rows, coins, temperature, topp)  # (B, T)
+        # accepted length: leading draft positions whose target matched
+        # (cumprod-of-matches sum), capped by the row's real draft count
+        di = jnp.arange(block - 1, dtype=jnp.int32)
+        match = ((targets[:, :-1] == proposals[:, 1:])
+                 & (di[None, :] < ndraft[:, None]))
+        acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        acc = jnp.where(live, acc, 0)
+        ridx = jnp.arange(b)
+        last = jnp.where(live, targets[ridx, acc], proposals[:, 0])
+        pos = jnp.where(live, start_pos + acc + 1, start_pos)
+        if mode == "sample":
+            # rewind the rng carry to the verified frontier: the block
+            # consumed exactly acc+1 coins (one per emitted token); greedy
+            # rows drew none, matching the host Sampler
+            drew = live & (temperature != 0.0)
+            rng_hi = jnp.where(drew, shs[acc, ridx], rng_hi)
+            rng_lo = jnp.where(drew, sls[acc, ridx], rng_lo)
+        return targets.T, acc, last, pos, rng_hi, rng_lo, kc, vc
+
+    from ..compat import shard_map
+
+    row = P(AXIS_DP) if dp > 1 else P()
+    mat = P(AXIS_DP, None) if dp > 1 else P()
+    toks_out = P(None, AXIS_DP) if dp > 1 else P()
+    sharded = shard_map(
+        loop, mesh=mesh,
+        in_specs=(param_specs, P(), P(), mat, kv_spec, kv_spec, row, row, row,
+                  row, row, row),
+        out_specs=(toks_out, row, row, row, row, row, kv_spec, kv_spec),
+        check_vma=False,
+    )
+    donate = (4, 5) if donate_cache else ()
+    jitted = jax.jit(sharded, donate_argnums=donate)
+
+    def run(p, rope: RopeTables, proposals, kc, vc, start_pos, rng,
+            temperature, topp, ndraft):
+        faults.fire("device_loop.verify_dispatch", block=block)
+        rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
+        toks, acc, tok, pos, sh, sl, kc, vc = jitted(
+            p, rope.cos, rope.sin, jnp.asarray(proposals, jnp.int32), kc, vc,
+            jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(topp, jnp.float32), jnp.asarray(ndraft, jnp.int32))
+        return toks, acc, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc
+
+    return run

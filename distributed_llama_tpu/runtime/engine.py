@@ -68,12 +68,17 @@ class GenerationStats:
     # multi-token loop ran. spec_step_ms keeps the real per-dispatch times.
     token_ms: list[float] = field(default_factory=list)
     infer_ms: list[float] = field(default_factory=list)
-    # speculative decoding (runtime/speculative.py): verify dispatches, draft
-    # tokens proposed/accepted, and each verify dispatch's wall time
+    # speculative decoding (runtime/speculative.py + the batched verify path
+    # in runtime/batch_engine.py): verify dispatches, draft tokens
+    # proposed/accepted, and each verify dispatch's wall time
     spec_steps: int = 0
     spec_drafted: int = 0
     spec_accepted: int = 0
     spec_step_ms: list[float] = field(default_factory=list)
+    # one (tokens_out_before, drafted, accepted) triple per verify turn —
+    # keyed by output length so the batched verify path can be oracle-checked
+    # against the sequential loop turn-for-turn (tests/test_batched_spec.py)
+    spec_turns: list = field(default_factory=list)
     # REAL per-dispatch times (one entry per device dispatch, however many
     # tokens it covered) — the honest latency series next to the synthetic
     # token_ms averages above. The same numbers feed the

@@ -72,24 +72,62 @@ class NgramIndex:
     n-size-1, i.e. the n-gram ends at most at n-1), so on each append to
     length m we register the grams ENDING at m-1 — exactly the newly-eligible
     occurrences. The dict keeps the largest start per gram, which is the
-    brute force's most-recent-wins scan order."""
+    brute force's most-recent-wins scan order.
+
+    Memory bound: the dicts gain one entry per UNIQUE n-gram for the life of
+    the index, which on a long-lived batched serving slot (one NgramIndex per
+    conversation, runtime/batch_engine.py) grows without bound. `max_entries`
+    caps the total: when registration crosses it the dicts are rebuilt from a
+    bounded tail window (sized so the rebuilt index holds at most
+    ~max_entries/2 entries), after which proposals only match occurrences
+    inside that window — recency is exactly what prompt-lookup prefers
+    anyway, so distant-history matches are the cheapest thing to shed. The
+    token list itself stays whole (ints, and propose() stores absolute start
+    indices into it)."""
 
     def __init__(self, tokens: list[int], *, max_ngram: int = 4,
-                 min_ngram: int = 1):
+                 min_ngram: int = 1, max_entries: int = 65536):
         self.tokens: list[int] = []
         self.max_ngram = max_ngram
         self.min_ngram = min_ngram
         self.sizes = range(min_ngram, max_ngram + 1)
+        self.max_entries = max_entries
+        self.window = max(max_entries // (2 * len(self.sizes)), 4 * max_ngram)
+        self._entries = 0
         self._last: dict[int, dict[tuple, int]] = {s: {} for s in self.sizes}
         self.extend(tokens)
 
+    def _register(self, end: int) -> None:
+        """Register the grams ENDING at token index `end` (their continuation
+        starts at `end`, so they just became legal occurrences)."""
+        for size in self.sizes:
+            if end >= size:
+                d = self._last[size]
+                gram = tuple(self.tokens[end - size:end])
+                if gram not in d:
+                    self._entries += 1
+                d[gram] = end - size
+
     def append(self, tok: int) -> None:
         self.tokens.append(tok)
+        self._register(len(self.tokens) - 1)
+        if self._entries > self.max_entries:
+            self._rebuild()
+
+    def _rebuild(self) -> None:
+        """Re-register only the grams ending inside the tail window; amortized
+        O(1) per append (each rebuild is O(window), triggered at most every
+        ~max_entries/2 appends)."""
         n = len(self.tokens)
-        for size in self.sizes:
-            if n - 1 >= size:  # gram ending at n-1 is now a legal occurrence
-                gram = tuple(self.tokens[n - 1 - size:n - 1])
-                self._last[size][gram] = n - 1 - size
+        self._last = {s: {} for s in self.sizes}
+        self._entries = 0
+        for end in range(max(n - self.window, self.min_ngram), n):
+            self._register(end)
+
+    @property
+    def entries(self) -> int:
+        """Total registered n-gram entries across sizes (memory gauge)."""
+        return self._entries
 
     def extend(self, tokens: list[int]) -> None:
         for t in tokens:
@@ -106,6 +144,35 @@ class NgramIndex:
             if start is not None:
                 return list(tokens[start + size:start + size + k])
         return []
+
+    def propose_extended(self, k: int) -> list[int]:
+        """propose(), re-proposed from the virtually extended sequence until
+        k tokens are drafted or the lookup goes dry.
+
+        Most-recent-wins truncates exactly where prompt-lookup shines: on a
+        cyclic tail (code/JSON repetition, greedy attractor loops) the most
+        recent occurrence of the tail n-gram overlaps the tail itself, so
+        its continuation is clipped to 1-2 tokens by the end of the list.
+        Treating the draft as accepted and looking up again (the tail n-gram
+        of tokens+draft, continuations still read from the real token list)
+        unrolls the cycle to the full k — the draft a verify block can
+        actually amortize. Each round adds >= 1 token, so at most k
+        lookups."""
+        out = self.propose(k)
+        while 0 < len(out) < k:
+            merged = self.tokens[-self.max_ngram:] + out
+            more: list[int] = []
+            for size in range(min(self.max_ngram, len(merged)),
+                              self.min_ngram - 1, -1):
+                start = self._last[size].get(tuple(merged[-size:]))
+                if start is not None:
+                    more = list(self.tokens[start + size:
+                                            start + size + k - len(out)])
+                    break
+            if not more:
+                break
+            out += more
+        return out[:k]
 
 
 def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
@@ -161,7 +228,8 @@ def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
         # while the ingest position after it stays BELOW seq_len (the
         # sequential loop breaks at pos >= seq_len before sampling again), so
         # the block may fill at most up to position seq_len-1
-        draft = history.propose(min(k, room - 1, max_tokens - len(out) - 1))
+        draft = history.propose_extended(
+            min(k, room - 1, max_tokens - len(out) - 1))
         block = [last] + draft
         pos_before = engine.pos
         with trace.span("spec.verify", {"draft": len(draft),
@@ -179,6 +247,7 @@ def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
             else:
                 break
         stats.spec_accepted += accepted
+        stats.spec_turns.append((len(out), len(draft), accepted))
         _VERIFY_STEPS.inc()
         _DRAFTED.inc(len(draft))
         _ACCEPTED.inc(accepted)

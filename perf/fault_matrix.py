@@ -46,6 +46,13 @@ KINDS = ("error", "transient", "latency")
 BATCH_POINTS = ("batch.submit", "batch.cache_seed", "batch.prefill",
                 "batch.dispatch", "batch.emit",
                 "device_loop.batched_dispatch")
+# speculation family (docs/SERVING.md "Speculative decoding"): the same
+# blast-radius promises under batched draft-verify super-steps — faults
+# mid-verify-dispatch and mid-accept-delivery, spec-enabled engines,
+# pipelined AND serialized. batch.emit rides along because with spec on it
+# fires inside the ACCEPT delivery loop (victim-only cells whose survivors
+# must additionally stay token-identical to a fault-free run).
+SPEC_POINTS = ("batch.verify", "device_loop.verify_dispatch", "batch.emit")
 ENGINE_POINTS = ("engine.dispatch", "device_loop.dispatch")
 PAGED_POINTS = ("paged.append", "paged.cold_attend")
 ROUTER_POINTS = ("router.proxy", "router.health")
@@ -63,13 +70,93 @@ def _greedy(spec):
     return Sampler(spec.vocab_size, temperature=0.0)
 
 
-def build_batch_engine(pipeline: bool = True):
+def build_batch_engine(pipeline: bool = True, speculative: int = 0):
     from distributed_llama_tpu.runtime.batch_engine import BatchEngine
 
     spec = _spec()
     params = init_random_params(spec, FloatType.Q40, seed=11)
     return spec, BatchEngine(spec, params, slots=2, tp=1, superstep=4,
-                             pipeline=pipeline)
+                             pipeline=pipeline, speculative=speculative)
+
+
+# n-gram-dense prompts: greedy decode on the seed-11 tiny model enters a
+# repetitive attractor, so verify dispatches engage within a few tokens —
+# spec_reference() asserts that, keeping the family non-vacuous
+SPEC_PAT = [7, 31, 5, 102, 9, 31, 5, 77]
+SPEC_PROMPTS = ([1] + SPEC_PAT * 3, [1, 2] + SPEC_PAT * 3)
+SPEC_GEN = 24
+
+
+def spec_reference(spec, be) -> dict:
+    """Fault-free reference outputs for the speculation family (also warms
+    every program the cells will hit). Keyed by prompt tuple so a cell can
+    check any completed request — victims excluded — against the tokens the
+    fault-free scheduler emits (survivor token-identity)."""
+    refs = {}
+    v0 = be.verify_steps
+    reqs = [(p, be.submit(list(p), SPEC_GEN, _greedy(spec)))
+            for p in SPEC_PROMPTS]
+    for p, r in reqs:
+        refs[tuple(p)] = r.wait(timeout=120)
+    assert be.verify_steps > v0, (
+        "speculation family is vacuous: no verify dispatch in the fault-free "
+        "reference run")
+    return refs
+
+
+def run_spec_cell(spec, be, point: str, kind: str, refs: dict) -> list[str]:
+    """One speculation cell: inject at `point` while spec-enabled requests
+    decode through verify dispatches, then assert the batch invariants PLUS
+    survivor token-identity — any request that completed without error must
+    have emitted exactly the fault-free reference tokens (rejected-draft
+    rollback and mid-accept faults must never corrupt a survivor)."""
+    problems: list[str] = []
+    # mid-accept-delivery faults target ONE slot so the cell always has a
+    # genuine victim/survivor split (an unmatched emit fault's first two
+    # fires would kill both co-batched requests, making survivor identity
+    # vacuous); dispatch-level faults stay unmatched — their engine blast
+    # radius is exactly what the cell probes
+    fs = _spec_for(point, kind)
+    if point == "batch.emit":
+        fs.match = {"slot": 0}
+    with faults.active(fs):
+        reqs = [(p, be.submit(list(p), SPEC_GEN, _greedy(spec)))
+                for p in SPEC_PROMPTS]
+        for p, r in reqs:
+            try:
+                out = r.wait(timeout=120)
+            except TimeoutError:
+                problems.append(f"{point}/{kind}: request hung (stuck slot)")
+                continue
+            except Exception:
+                continue  # the injected victim — expected
+            if out != refs[tuple(p)]:
+                problems.append(
+                    f"{point}/{kind}: survivor diverged from fault-free "
+                    f"reference ({out[:6]}... vs {refs[tuple(p)][:6]}...)")
+    faults.uninstall()
+    if not be.scheduler_alive():
+        problems.append(f"{point}/{kind}: scheduler thread DIED")
+        return problems
+    try:
+        probe = be.submit(list(SPEC_PROMPTS[0]), SPEC_GEN, _greedy(spec))
+        out = probe.wait(timeout=120)
+        if out != refs[tuple(SPEC_PROMPTS[0])] or probe.error is not None:
+            problems.append(f"{point}/{kind}: probe degraded "
+                            f"({len(out)} tokens, err={probe.error!r})")
+    except Exception as e:
+        problems.append(f"{point}/{kind}: probe failed: {e!r}")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with be._plock:
+            leaked = [s for s in be._slots
+                      if s.req is not None or s.lease is not None]
+        if not leaked and not be._pending and be._queue.empty():
+            break
+        time.sleep(0.01)
+    else:
+        problems.append(f"{point}/{kind}: slot/lease leak after probe")
+    return problems
 
 
 def build_engine(paged: bool = False):
@@ -283,6 +370,22 @@ def run_matrix(include_paged: bool = True,
                     problems += [f"[{tag}] {p}"
                                  for p in run_batch_cell(bspec, be, point,
                                                          kind)]
+        finally:
+            be.close()
+    # speculation family: same invariants with batched draft-verify
+    # super-steps engaged, plus survivor token-identity, under both
+    # schedulers (docs/SERVING.md "Speculative decoding")
+    for pipeline in (True, False):
+        bspec, be = build_batch_engine(pipeline=pipeline, speculative=4)
+        tag = "spec-pipelined" if pipeline else "spec-serialized"
+        try:
+            refs = spec_reference(bspec, be)
+            for point in SPEC_POINTS:
+                for kind in kinds:
+                    cells += 1
+                    problems += [f"[{tag}] {p}"
+                                 for p in run_spec_cell(bspec, be, point,
+                                                        kind, refs)]
         finally:
             be.close()
     espec, eng = build_engine()

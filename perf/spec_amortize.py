@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Smoke: verify-block dispatch cost must stay near-flat in block length T
+(ISSUE 8 CI gate) — the roofline argument batched speculative decoding rests
+on.
+
+A (B, T) verify dispatch streams the quantized weights ONCE for all T
+positions, so on a bandwidth-bound chip (and on this CPU mesh, where the
+tiny model's per-dispatch overhead dominates the extra matmul columns) the
+cost of T = 1+k must sit well under T times the cost of T = 2. If this ratio
+regresses, the verify program stopped amortizing the weight stream — e.g. a
+lowering change serialized the block positions — and the default --speculative
+K stops paying for itself exactly when accept rates are high.
+
+Measures the REAL program the BatchEngine compiles
+(runtime/device_loop.py make_batched_verify_loop) at every block bucket the
+scheduler uses (2, 3, 5, 9 for k=8), median of repeated timed dispatches
+with the token block fetched to host (the scheduler's sync point).
+
+Run: JAX_PLATFORMS=cpu python perf/spec_amortize.py
+Prints one JSON line (bench.py convention); exit 0 pass, 1 fail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from distributed_llama_tpu.models.params import init_random_params  # noqa: E402
+from distributed_llama_tpu.models.spec import (ArchType, ModelSpec,  # noqa: E402
+                                               RopeType)
+from distributed_llama_tpu.quants import FloatType  # noqa: E402
+
+B = 4  # batch rows
+K = 8  # draft cap: blocks 2, 3, 5, 9 (the scheduler's _verify_block_for)
+BLOCKS = (2, 3, 5, 9)
+REPS = 30
+GATE = 2.5  # median cost(T=1+K) must stay under GATE x median cost(T=2)
+
+
+def _spec(seq_len=128):
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=seq_len, rope_type=RopeType.LLAMA).resolved()
+
+
+def measure(spec=None, params=None) -> dict[int, float]:
+    """Median seconds per verify dispatch at each block length."""
+    from distributed_llama_tpu.runtime.device_loop import \
+        make_batched_verify_loop
+    from distributed_llama_tpu.runtime.engine import Engine
+
+    spec = spec or _spec()
+    if params is None:
+        params = init_random_params(spec, FloatType.Q40, seed=11)
+    eng = Engine(spec, params, tp=1, batch=B)
+    kc, vc = eng.k_cache, eng.v_cache
+    rng = np.zeros((B, 2), np.uint32)
+    temps = [0.0] * B
+    topps = [0.9] * B
+    out: dict[int, float] = {}
+    pos0 = 32  # mid-cache: every block bucket fits under seq_len
+    for t in BLOCKS:
+        loop = make_batched_verify_loop(spec, eng.mesh, eng.params, t,
+                                        mode="greedy", dtype=eng.dtype,
+                                        donate_cache=True)
+        props = [[(7 * (i + j)) % spec.vocab_size for j in range(t)]
+                 for i in range(B)]
+        ndraft = [t - 1] * B
+        starts = [pos0] * B
+
+        def dispatch():
+            nonlocal kc, vc
+            toks, acc, tok, pos, r, kc, vc = loop(
+                eng.params, eng.rope, props, kc, vc, starts, rng, temps,
+                topps, ndraft)
+            np.asarray(toks)  # host sync: the scheduler's delivery point
+
+        dispatch()  # compile
+        times = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            dispatch()
+            times.append(time.perf_counter() - t0)
+        out[t] = statistics.median(times)
+    return out
+
+
+def main() -> int:
+    costs = measure()
+    ratio = costs[BLOCKS[-1]] / costs[BLOCKS[0]]
+    ok = ratio <= GATE
+    print(json.dumps({
+        "metric": "spec_verify_amortization",
+        "value": round(ratio, 3), "unit": "xT2_cost", "vs_baseline": None,
+        "gate": GATE, "ok": ok,
+        "cost_ms": {str(t): round(c * 1e3, 4) for t, c in costs.items()},
+        "blocks": list(BLOCKS), "batch": B, "reps": REPS,
+    }))
+    if not ok:
+        print(f"❌ verify block T={BLOCKS[-1]} costs {ratio:.2f}x T=2 "
+              f"(gate {GATE}x): the verify program stopped amortizing the "
+              f"weight stream", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,8 +16,11 @@ def test_fault_matrix_no_scheduler_death_or_slot_leak():
     cells, problems = fault_matrix.run_matrix(include_paged=True)
     # the batch family runs twice: pipelined AND serialized super-steps —
     # every injection point's invariants must hold under overlapped
-    # dispatches too (docs/SERVING.md "Pipelined decode")
+    # dispatches too (docs/SERVING.md "Pipelined decode"); the speculation
+    # family likewise runs spec-enabled engines under both schedulers with
+    # survivor token-identity on its victim-only cells
     expected = (2 * len(fault_matrix.BATCH_POINTS)
+                + 2 * len(fault_matrix.SPEC_POINTS)
                 + len(fault_matrix.ENGINE_POINTS)
                 + len(fault_matrix.PAGED_POINTS)
                 + len(fault_matrix.ROUTER_POINTS)) * len(fault_matrix.KINDS)
@@ -29,7 +32,8 @@ def test_matrix_covers_documented_inventory():
     """Every runtime injection point named in docs/ROBUSTNESS.md must be in
     the matrix — adding a fire() site without matrix coverage is exactly the
     silent-cap failure mode this wrapper exists to prevent."""
-    covered = set(fault_matrix.BATCH_POINTS + fault_matrix.ENGINE_POINTS
+    covered = set(fault_matrix.BATCH_POINTS + fault_matrix.SPEC_POINTS
+                  + fault_matrix.ENGINE_POINTS
                   + fault_matrix.PAGED_POINTS + fault_matrix.ROUTER_POINTS)
     doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
                             "ROBUSTNESS.md")).read()

@@ -210,3 +210,59 @@ def test_generate_with_dispatches_speculative(spec_params):
     out_spec, st = b.generate_with(prompt, 24, _greedy(spec), speculative_k=6)
     assert out_seq == out_spec
     assert hasattr(st, "spec_steps")
+
+
+# ----------------------------------------------- memory bound / extension
+
+
+def test_ngram_index_entry_cap_bounds_memory():
+    """The _last dicts gain one entry per unique n-gram for the life of the
+    index; a long-lived batched serving slot must not grow without bound.
+    An adversarial stream of unique grams must keep total entries at or
+    under max_entries at every step (ISSUE 8 satellite)."""
+    cap = 512
+    idx = NgramIndex([], max_entries=cap)
+    rs = np.random.RandomState(3)
+    for i in range(20_000):
+        # wide token range: nearly every gram is unique
+        idx.append(int(rs.randint(0, 1_000_000)))
+        assert idx.entries <= cap, (i, idx.entries)
+    assert sum(len(d) for d in idx._last.values()) == idx.entries
+
+
+def test_ngram_index_cap_keeps_recent_matches():
+    """After eviction rebuilds from the tail window, grams INSIDE the window
+    still propose exactly like the brute force over that suffix would —
+    recency is what prompt-lookup uses, so that's what the cap preserves."""
+    cap = 256
+    idx = NgramIndex([], max_entries=cap)
+    rs = np.random.RandomState(5)
+    stream = [int(t) for t in rs.randint(0, 1_000_000, 5000)]
+    pat = [42, 43, 44, 45, 46, 47]
+    tail = stream + pat + [int(t) for t in rs.randint(0, 1_000_000, 8)] + pat[:4]
+    idx.extend(tail)
+    # the tail 4-gram [42,43,44,45] recurs inside the rebuilt window
+    assert idx.propose(2) == [46, 47]
+
+
+def test_propose_extended_unrolls_cycles():
+    """Most-recent-wins clips the continuation at the end of the list on a
+    cyclic tail; propose_extended re-proposes from the virtually extended
+    sequence and must unroll the cycle to the full k."""
+    cyc = [9, 5, 7]
+    idx = NgramIndex([1, 2, 3] + cyc * 6)
+    k = 8
+    got = idx.propose_extended(k)
+    assert len(got) == k
+    # the draft continues the cycle exactly
+    want = (cyc * 5)[:k]
+    start = cyc.index(got[0])
+    assert got == (cyc[start:] + cyc * 3)[:k], (got, want)
+
+
+def test_propose_extended_matches_propose_when_unclipped():
+    """When the most recent occurrence has a full-length continuation,
+    propose_extended adds nothing beyond propose()."""
+    toks = [5, 6, 7, 8, 9, 10, 11, 12, 5, 6, 7]
+    idx = NgramIndex(toks)
+    assert idx.propose_extended(3) == idx.propose(3) == [8, 9, 10]
