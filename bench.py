@@ -469,6 +469,75 @@ def _write_fleet_model(outdir: str) -> tuple[str, str]:
     return mpath, tpath
 
 
+def _fleet_free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fleet_get_json(port, path, timeout=10):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _spawn_fleet_replicas(tmp, mpath, tpath, ports, extra_argv=(),
+                          trace_dir=None):
+    """Launch one api_server subprocess per port (tiny fleet checkpoint,
+    CPU), env-scrubbed so chaos config never leaks into acceptance
+    replicas. Shared by the shared-prefix and chaos fleet benches — the
+    startup machinery must not drift between them. Returns (procs, logs)."""
+    import subprocess
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root,
+               DLT_HANDOFF_PATH="", DLLAMA_FAULTS="", DLLAMA_FAULT_SEED="")
+    procs, logs = [], []
+    for port in ports:
+        log = open(os.path.join(tmp, f"replica_{port}.log"), "w")
+        logs.append(log)
+        argv = [sys.executable, "-m", "distributed_llama_tpu.apps.api_server",
+                "--model", mpath, "--tokenizer", tpath, "--chat-template",
+                "chatml", "--host", "127.0.0.1", "--port", str(port),
+                "--batch", "2", "--superstep", "4", *extra_argv]
+        if trace_dir is not None:
+            # replica-side tracing: the router's GET /v1/trace pulls each
+            # replica's live buffer into the merged Perfetto file
+            argv += ["--trace", os.path.join(trace_dir, f"trace_{port}.json")]
+        procs.append(subprocess.Popen(
+            argv, env=env, stdout=log, stderr=subprocess.STDOUT,
+            cwd=repo_root))
+    return procs, logs
+
+
+def _await_fleet_healthy(procs, ports, tmp, timeout_s=300):
+    deadline = time.time() + timeout_s
+    for port, proc in zip(ports, procs):
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica :{port} died during startup "
+                    f"(see {tmp}/replica_{port}.log)")
+            try:
+                if _fleet_get_json(port, "/healthz", timeout=2)[0] == 200:
+                    break
+            except OSError:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError(f"replica :{port} never became healthy")
+            time.sleep(0.5)
+
+
 def fleet_shared_prefix_workload(args, spec):
     """--workload shared-prefix --replicas N [--routing affinity|random]
     [--kill-replica]: the fleet-tier acceptance bench (docs/FLEET.md).
@@ -484,7 +553,6 @@ def fleet_shared_prefix_workload(args, spec):
     failover must complete EVERY request with no client-visible failure."""
     import http.client
     import signal
-    import socket
     import subprocess
     import tempfile
     import threading
@@ -495,64 +563,19 @@ def fleet_shared_prefix_workload(args, spec):
     n_rep = args.replicas
     tmp = tempfile.mkdtemp(prefix="dlt_fleet_")
     mpath, tpath = _write_fleet_model(tmp)
-
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
-
-    ports = [free_port() for _ in range(n_rep)]
-    repo_root = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root,
-               DLT_HANDOFF_PATH="", DLLAMA_FAULTS="", DLLAMA_FAULT_SEED="")
+    ports = [_fleet_free_port() for _ in range(n_rep)]
     if args.trace_fleet and obs_trace.current() is None:
         # the router runs in THIS process: its proxy spans must record for
         # the merged fleet trace (replicas get --trace below)
         obs_trace.install(process_name="router")
-    procs, logs = [], []
-    for port in ports:
-        log = open(os.path.join(tmp, f"replica_{port}.log"), "w")
-        logs.append(log)
-        argv = [sys.executable, "-m", "distributed_llama_tpu.apps.api_server",
-                "--model", mpath, "--tokenizer", tpath, "--chat-template",
-                "chatml", "--host", "127.0.0.1", "--port", str(port),
-                "--batch", "2", "--superstep", "4", "--drain-timeout", "60"]
-        if args.trace_fleet:
-            # replica-side tracing: the router's GET /v1/trace pulls each
-            # replica's live buffer into the merged Perfetto file
-            argv += ["--trace", os.path.join(tmp, f"trace_{port}.json")]
-        procs.append(subprocess.Popen(
-            argv, env=env, stdout=log, stderr=subprocess.STDOUT,
-            cwd=repo_root))
-
-    def _get_json(port, path, timeout=10):
-        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
-        try:
-            conn.request("GET", path)
-            resp = conn.getresponse()
-            return resp.status, json.loads(resp.read() or b"{}")
-        finally:
-            conn.close()
+    procs, logs = _spawn_fleet_replicas(
+        tmp, mpath, tpath, ports, extra_argv=("--drain-timeout", "60"),
+        trace_dir=tmp if args.trace_fleet else None)
+    _get_json = _fleet_get_json
 
     router = None
     try:
-        deadline = time.time() + 300
-        for port, proc in zip(ports, procs):
-            while True:
-                if proc.poll() is not None:
-                    raise RuntimeError(
-                        f"replica :{port} died during startup "
-                        f"(see {tmp}/replica_{port}.log)")
-                try:
-                    if _get_json(port, "/healthz", timeout=2)[0] == 200:
-                        break
-                except OSError:
-                    pass
-                if time.time() > deadline:
-                    raise RuntimeError(f"replica :{port} never became healthy")
-                time.sleep(0.5)
+        _await_fleet_healthy(procs, ports, tmp)
         router = serve_router([f"127.0.0.1:{p}" for p in ports],
                               host="127.0.0.1", port=0, policy=args.routing,
                               poll_interval=0.5, block_bytes=32, retries=2,
@@ -1101,6 +1124,226 @@ def chaos_workload(args, spec):
     }))
 
 
+def chaos_fleet_workload(args, spec):
+    """--workload chaos --replicas N --kill-replica: the durable-request
+    acceptance bench (docs/FLEET.md "Resume protocol"). Launches N real
+    api_server subprocesses + the in-process DURABLE router, runs the
+    identical request schedule twice — fault-free reference, then with one
+    replica SIGKILLed (hard, no drain: the mid-stream failure graceful
+    SIGTERM would hide) once the marker stream has delivered a few tokens —
+    and asserts IN-RUN that every chaos-phase request completed with output
+    byte-identical to its reference (greedy AND seeded-stochastic rows).
+    Reports the resumed-request count from the router journal and the
+    resume re-prefill prefix-cache reuse rate summed over the surviving
+    replicas (nonzero = resume cost ≈ one suffix prefill, the tentpole's
+    cost claim)."""
+    import http.client
+    import subprocess
+    import tempfile
+    import threading
+
+    from distributed_llama_tpu.fleet.router import close_router, serve_router
+    from distributed_llama_tpu.obs import metrics as obs_metrics
+
+    n_rep = args.replicas
+    if n_rep < 2:
+        print("❌ --workload chaos --kill-replica needs --replicas >= 2 "
+              "(a killed singleton has no survivor to resume on)",
+              file=sys.stderr)
+        sys.exit(2)
+    tmp = tempfile.mkdtemp(prefix="dlt_chaos_fleet_")
+    mpath, tpath = _write_fleet_model(tmp)
+    ports = [_fleet_free_port() for _ in range(n_rep)]
+    procs, logs = _spawn_fleet_replicas(
+        tmp, mpath, tpath, ports,
+        extra_argv=("--supervisor-threshold", "120"))
+    _get_json = _fleet_get_json
+
+    n_req = max(args.requests, 6)
+    gen = 32
+    system = "fleet chaos shared system prompt abcb abcb abcb"
+
+    def req_body(i):
+        # greedy AND seeded-stochastic rows, streaming AND non-streaming —
+        # every combination must survive the kill token-identically
+        return {"messages": [
+            {"role": "system", "content": system},
+            {"role": "user", "content": f"request {i} ab ab ab ab"}],
+            "max_tokens": gen, "stream": i % 3 != 2,
+            "temperature": 0.0 if i % 2 == 0 else 0.8,
+            "seed": 1000 + i}
+
+    def one_request(rport, i, results, on_delta=None):
+        body = req_body(i)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                              timeout=300)
+            conn.request("POST", "/v1/chat/completions", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            replica = resp.getheader("X-Replica")
+            if not body["stream"]:
+                data = json.loads(resp.read() or b"{}")
+                if resp.status != 200:
+                    results[i] = {"error": f"status {resp.status}: {data}"}
+                    return
+                results[i] = {"text": data["choices"][0]["message"]
+                              ["content"],
+                              "finish": data["choices"][0]["finish_reason"],
+                              "replica": replica}
+                return
+            if resp.status != 200:
+                results[i] = {"error": f"status {resp.status}"}
+                return
+            text, finish, n = [], None, 0
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                payload = json.loads(line[6:])
+                if "error" in payload:
+                    results[i] = {"error": payload["error"]}
+                    return
+                d = payload["choices"][0]["delta"].get("content")
+                f = payload["choices"][0].get("finish_reason")
+                if f:
+                    finish = f
+                if d:
+                    text.append(d)
+                    n += 1
+                    if on_delta is not None:
+                        on_delta(n, replica)
+            results[i] = {"text": "".join(text), "finish": finish,
+                          "replica": replica}
+        except Exception as e:
+            results[i] = {"error": repr(e)}
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    router = None
+    try:
+        _await_fleet_healthy(procs, ports, tmp)
+        router = serve_router([f"127.0.0.1:{p}" for p in ports],
+                              host="127.0.0.1", port=0, poll_interval=0.5,
+                              block_bytes=32, retries=2, try_timeout=300.0,
+                              durable=True)
+        rport = router.server_address[1]
+        threading.Thread(target=router.serve_forever, daemon=True).start()
+
+        def run_phase(kill: bool):
+            results = [None] * n_req
+            killed = []
+
+            def on_marker_delta(n, replica):
+                # SIGKILL the replica serving the marker stream once real
+                # output has flowed — a hard mid-stream death, the case the
+                # journal + resume machinery exists for
+                if kill and n == 3 and not killed and replica:
+                    victim_port = int(replica.rsplit(":", 1)[1])
+                    killed.append(replica)
+                    procs[ports.index(victim_port)].kill()
+            threads = []
+            sem = threading.Semaphore(2 * n_rep)
+
+            def run_one(i):
+                with sem:
+                    one_request(rport, i, results,
+                                on_delta=on_marker_delta if i == 0 else None)
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                t = threading.Thread(target=run_one, args=(i,))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=600)
+            return results, killed, time.perf_counter() - t0
+
+        ref, _, _ = run_phase(kill=False)
+        ref_failed = [(i, r) for i, r in enumerate(ref)
+                      if r is None or "error" in r]
+        if ref_failed:
+            print(f"❌ fault-free reference phase failed: {ref_failed[:3]}",
+                  file=sys.stderr)
+            sys.exit(1)
+        resumed0 = (obs_metrics.snapshot()
+                    .get("router_resumed_requests_total") or 0)
+        chaos, killed, wall = run_phase(kill=True)
+        failed = [(i, r) for i, r in enumerate(chaos)
+                  if r is None or "error" in r]
+        diverged = [i for i, (a, b) in enumerate(zip(ref, chaos))
+                    if a and b and "error" not in b
+                    and a["text"] != b["text"]]
+        snap = obs_metrics.snapshot()
+        resumed = (snap.get("router_resumed_requests_total") or 0) - resumed0
+        # resume re-prefill reuse over the SURVIVING replicas: the resumed
+        # requests' prompt ⊕ delivered prefixes vs what their admissions
+        # actually re-ran (slot rewind + radix pool seed)
+        reused = prefix = 0.0
+        for port, proc in zip(ports, procs):
+            if proc.poll() is not None:
+                continue
+            try:
+                st, body = _get_json(port, "/v1/stats", timeout=10)
+            except OSError:
+                continue
+            m = (body or {}).get("metrics") or {}
+            reused += m.get("api_resume_reused_tokens_total", 0) or 0
+            prefix += m.get("api_resume_prefix_tokens_total", 0) or 0
+        reuse_rate = round(reused / prefix, 3) if prefix else 0.0
+        print(json.dumps({
+            "metric": "chaos_kill_replica_resumed_requests",
+            "value": int(resumed), "unit": "requests", "vs_baseline": None,
+            "replicas": n_rep, "requests": n_req, "gen_tokens": gen,
+            "killed_replica": killed[0] if killed else None,
+            "failed_requests": len(failed),
+            "failures": [f"{i}: {r}" for i, r in failed[:5]],
+            "diverged_requests": diverged,
+            "identical": not failed and not diverged,
+            "resume_prefix_reuse_rate": reuse_rate,
+            "resume_reused_tokens": int(reused),
+            "resume_prefix_tokens": int(prefix),
+            "wall_s": round(wall, 2),
+        }))
+        # in-run acceptance gates (ISSUE 9): a kill that never engaged, a
+        # client-visible failure, a diverged resume, or a resume that
+        # re-prefilled everything from scratch all fail the bench
+        if not killed:
+            print("❌ the kill never engaged (marker stream finished first)",
+                  file=sys.stderr)
+            sys.exit(1)
+        if failed or diverged:
+            print(f"❌ {len(failed)} failed, {len(diverged)} diverged",
+                  file=sys.stderr)
+            sys.exit(1)
+        if resumed < 1:
+            print("❌ no request was resumed — the kill was not mid-stream",
+                  file=sys.stderr)
+            sys.exit(1)
+        if reuse_rate <= 0.0:
+            print("❌ resume re-prefill hit nothing in the prefix cache",
+                  file=sys.stderr)
+            sys.exit(1)
+    finally:
+        if router is not None:
+            close_router(router)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for log in logs:
+            log.close()
+
+
 def vs_baseline(args, tok_s: float):
     """Ratio vs the reference's published number — which exists only for the
     Llama-2-7B single-node config (README.md:131). Other archs report null rather
@@ -1333,12 +1576,17 @@ def main():
                                  or args.batch > 0):
         ap.error("--speculative S applies to the batched scheduler: combine "
                  "with --batch B (engine mode) or --workload repetition")
-    if args.replicas and args.workload != "shared-prefix":
+    if args.replicas and args.workload not in ("shared-prefix", "chaos"):
         ap.error("--replicas N is the fleet tier of "
-                 "--workload shared-prefix (docs/FLEET.md); N=1 is the "
-                 "single-replica baseline the acceptance compares against")
+                 "--workload shared-prefix / chaos (docs/FLEET.md); N=1 is "
+                 "the single-replica baseline the acceptance compares "
+                 "against")
     if args.kill_replica and not args.replicas:
         ap.error("--kill-replica requires --replicas N")
+    if args.workload == "chaos" and args.replicas and not args.kill_replica:
+        ap.error("--workload chaos --replicas N is the mid-stream "
+                 "replica-kill mode: add --kill-replica (the in-process "
+                 "fault-rate chaos bench takes no --replicas)")
     if args.trace_fleet and not args.replicas:
         ap.error("--trace-fleet requires --replicas N (the fleet tier of "
                  "--workload shared-prefix)")
@@ -1470,7 +1718,13 @@ def main():
             shared_prefix_workload(args, spec)
         return
     if args.workload == "chaos":
-        chaos_workload(args, spec)
+        if args.replicas >= 1:
+            # fleet chaos (docs/FLEET.md "Resume protocol"): real replica
+            # subprocesses + the durable router, SIGKILL one mid-stream —
+            # every request must complete with resumed outputs byte-identical
+            chaos_fleet_workload(args, spec)
+        else:
+            chaos_workload(args, spec)
         return
     if args.workload == "repetition":
         if not on_tpu and not args.small and args.arch == "llama2_7b":

@@ -31,7 +31,8 @@ from ..obs.process import install_process_metrics
 from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
                                  EngineDraining, EngineSaturated,
-                                 InvalidRequest)
+                                 EngineWedged, InvalidRequest, retriable)
+from ..resilience.quiet_http import QuietServer
 from ..runtime.engine import Engine
 from ..runtime.sampler import Sampler
 from ..tokenizer import ChatItem, ChatTemplate, EosDetector, TemplateType
@@ -51,6 +52,25 @@ _E2E = metrics.histogram(
 _HTTP = metrics.counter(
     "api_http_requests_total", "HTTP requests by route and status code",
     labelnames=("route", "code"))
+# Durable-request resume admissions (docs/FLEET.md "Resume protocol"): how
+# many mid-stream-failover re-submits this replica served, how much resumed
+# generation they carried, and how much of each resume's prompt ⊕ delivered
+# prefix the admission reused instead of re-prefilling (the "resume cost ≈
+# one suffix prefill" health signal a chaos bench asserts is nonzero).
+_RESUMED = metrics.counter(
+    "api_resumed_requests_total",
+    "Completions admitted with a resume payload (router failover re-submits)")
+_RESUME_TOKENS = metrics.counter(
+    "api_resume_tokens_total",
+    "Delivered-elsewhere tokens carried by resume payloads (RNG coins "
+    "fast-forwarded; tokens re-fed through the stop detector)")
+_RESUME_PREFIX = metrics.counter(
+    "api_resume_prefix_tokens_total",
+    "Total prompt ⊕ delivered prefix length of resume admissions")
+_RESUME_REUSED = metrics.counter(
+    "api_resume_reused_tokens_total",
+    "Resume prefix tokens whose prefill was skipped (slot rewind + radix "
+    "prefix-cache seed) at resume admission")
 
 _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
                  "/v1/stats", "/metrics", "/health", "/healthz",
@@ -101,6 +121,10 @@ class ApiState:
         # server-side wall-clock deadline applied to every batched request
         # (seconds; 0 = none) — the scheduler enforces it, finish "deadline"
         self.request_deadline = request_deadline
+        # hung-engine supervisor (resilience/supervisor.py): set by serve()
+        # when --supervisor-threshold > 0; /healthz folds its health in so
+        # a wedged replica is ejected from fleet rotation while it recovers
+        self.supervisor = None
         # single-slot prefix reuse (cache/single_slot.py, ex-NaiveCache): the
         # resident-conversation rewind plus the cross-conversation radix pool.
         # Batched mode needs neither — slot assignment and prefix reuse live
@@ -195,6 +219,8 @@ def _stats_payload(state: "ApiState") -> dict:
     out: dict = {"model": state.model_name, "time": _now(),
                  "replica": _load_block(state),
                  "metrics": metrics.snapshot()}
+    if state.supervisor is not None:
+        out["supervisor"] = state.supervisor.stats()
     be = state.batch_engine
     pc = (be.prefix_cache if be is not None
           else state.cache.cache if state.cache is not None else None)
@@ -257,8 +283,37 @@ def _observe_done(t_start: float, ttft: list, n_tokens: int,
         e2e_ms=round(dt * 1e3, 3), tokens=n_tokens)
 
 
-def run_completion(state: ApiState, body: dict, emit):
+def _parse_resume(body: dict, spec) -> list[int]:
+    """Validate the durable-resume payload (docs/FLEET.md "Resume protocol"):
+    `{"resume": {"tokens": [...]}}` — the generated tokens a failed replica
+    already delivered, which this replica must treat as committed output:
+    prefill them (mostly a prefix-cache hit), fast-forward the sampler past
+    their coins, re-feed them through the stop detector (so a stop sequence
+    spanning the failover boundary still fires), and continue generation
+    byte-identical to the uninterrupted run."""
+    raw = body.get("resume")
+    if raw is None:
+        return []
+    if not isinstance(raw, dict) or not isinstance(raw.get("tokens"), list):
+        raise InvalidRequest("'resume' must be {\"tokens\": [int, ...]}")
+    toks = raw["tokens"]
+    if not all(isinstance(t, int) and not isinstance(t, bool)
+               and 0 <= t < spec.vocab_size for t in toks):
+        raise InvalidRequest(
+            f"'resume.tokens' must be token ids in [0, {spec.vocab_size})")
+    return list(toks)
+
+
+def run_completion(state: ApiState, body: dict, emit, *, journal=None,
+                   deadline_s: float | None = None):
     """Shared completion core. `emit(text_delta)` streams; returns (text, finish).
+
+    `journal` (durable routing, docs/FLEET.md): a mutable {"toks": [], "n": 0}
+    the caller owns — every text delta's newly-flushed token ids are appended
+    (and "n" advanced to the cumulative delivered count) BEFORE emit runs, so
+    the streaming layer can stamp them onto the same SSE chunk as the text
+    they produced. `deadline_s` is the remaining client deadline relayed via
+    X-Deadline-Ms (min-combined with the server's --request-deadline).
 
     Raises typed resilience errors BEFORE any generation work so the HTTP
     layer can map them to honest status codes (InvalidRequest -> 400,
@@ -294,10 +349,20 @@ def run_completion(state: ApiState, body: dict, emit):
     # never a 500 or a stall. A prompt at/over seq_len has no room to decode
     # even one token; max_tokens must be a non-negative integer (explicit 0 /
     # null keep the fill-the-context default, OpenAI null semantics).
+    resume = _parse_resume(body, spec)
     if len(prompt) >= spec.seq_len:
         raise InvalidRequest(
             f"prompt is {len(prompt)} tokens but the model context is "
             f"{spec.seq_len}; reduce the conversation or raise --max-seq-len")
+    if len(prompt) + len(resume) > spec.seq_len:
+        # strictly MORE than the context could ever have generated: a
+        # malformed payload, not a legitimate resume. == seq_len is the
+        # legitimate edge — the original run ended at the context wall
+        # after its last delivered token, so the resume re-emits the
+        # delivered text and finishes "length" with zero new tokens.
+        raise InvalidRequest(
+            f"resume carries {len(resume)} tokens but the context has room "
+            f"for {spec.seq_len - len(prompt)} past the prompt")
     mt_raw = _opt(body, "max_tokens", 0)
     if isinstance(mt_raw, bool) or not isinstance(mt_raw, int) or mt_raw < 0:
         raise InvalidRequest(
@@ -308,7 +373,28 @@ def run_completion(state: ApiState, body: dict, emit):
         float(_opt(body, "top_p", state.default_sampler.topp)),
         int(_opt(body, "seed", _now())),
     )
-    max_tokens = mt_raw or (spec.seq_len - len(prompt))
+    # the TOTAL budget is derived from the ORIGINAL prompt so a resumed
+    # request stops at exactly the position the uninterrupted run would
+    # have; the delivered tokens already spent part of it, and the context
+    # wall caps it (a resume at the wall legitimately has zero budget)
+    max_tokens = max(min((mt_raw or (spec.seq_len - len(prompt)))
+                         - len(resume),
+                         spec.seq_len - len(prompt) - len(resume)), 0)
+    if resume:
+        # the RNG half of byte-identical resume: every stochastic sample
+        # drew exactly one xorshift* coin, greedy drew none — skip the
+        # delivered tokens' coins so the continuation replays the
+        # uninterrupted run's stream (runtime/sampler.py)
+        sampler.fast_forward(len(resume))
+        _RESUMED.inc()
+        _RESUME_TOKENS.inc(len(resume))
+        _RESUME_PREFIX.inc(len(prompt) + len(resume))
+        flight.event(None, "resume_admitted", tokens=len(resume))
+    # remaining-deadline propagation (docs/FLEET.md): the header-relayed
+    # client deadline and the server-side --request-deadline compose by min
+    # — a resumed request must never outlive the deadline the client set
+    deadlines = [d for d in (state.request_deadline, deadline_s) if d]
+    eff_deadline = min(deadlines) if deadlines else 0.0
 
     stops = tok.chat_stops()
     stop_param = _opt(body, "stop", [])
@@ -327,57 +413,106 @@ def run_completion(state: ApiState, body: dict, emit):
         # backpressures only its own handler thread, never the shared decode loop.
         import queue as _queue
 
-        deltas: "_queue.Queue[str | None]" = _queue.Queue()
+        deltas: "_queue.Queue[tuple | None]" = _queue.Queue()
+        # token ids delivered since the last text flush: on_token appends on
+        # the scheduler thread, and the streamer's synchronous emit drains
+        # them into the SAME queue entry as the text they produced — the
+        # token/text pairing the durable router's journal rides on
+        pending_toks: list[int] = []
 
         def emit_queued(d: bytes):
             text = d.decode("utf-8", errors="replace")
             pieces.append(text)
-            deltas.put(text)
+            toks, pending_toks[:] = pending_toks[:], []
+            deltas.put((text, toks))
 
         qstreamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t),
                                   emit_queued)
-        req = state.batch_engine.submit(
-            prompt, max_tokens, sampler, on_token=qstreamer.on_token,
-            stop_check=qstreamer.stop_check,
-            deadline=state.request_deadline or None)
-        # sentinel closes the drain loop the moment the request completes (the puts
-        # happen-before done.set(), so everything queued is drained first)
-        threading.Thread(target=lambda: (req.done.wait(), deltas.put(None)),
-                         daemon=True).start()
+
+        def on_token(t: int):
+            pending_toks.append(t)
+            qstreamer.on_token(t)
+
+        # resume re-feed (docs/FLEET.md): run the delivered tokens through
+        # the SAME streamer before generation — their text re-emits (the
+        # router splices by position, the client never sees a repeat) and
+        # the stop detector ends up in the exact mid-stream state the failed
+        # replica's was, so a stop sequence spanning the failover boundary
+        # still fires
+        for t in resume:
+            if qstreamer.stopped:
+                break
+            on_token(t)
+        req = None
+        # a resume with zero remaining budget (the original run ended at
+        # its token/context limit right after the last delivered token)
+        # needs NO engine work: the re-fed text is the full completion
+        if not qstreamer.stopped and not (resume and max_tokens == 0):
+            req = state.batch_engine.submit(
+                prompt + resume, max_tokens, sampler, on_token=on_token,
+                stop_check=qstreamer.stop_check,
+                deadline=eff_deadline or None,
+                resume_tokens=len(resume))
+            # sentinel closes the drain loop the moment the request completes
+            # (the puts happen-before done.set(), so everything queued is
+            # drained first)
+            threading.Thread(target=lambda: (req.done.wait(),
+                                             deltas.put(None)),
+                             daemon=True).start()
+        else:
+            deltas.put(None)
         try:
             while (item := deltas.get()) is not None:
-                emit(item)
+                text, toks = item
+                if journal is not None:
+                    journal["toks"].extend(toks)
+                    journal["n"] += len(toks)
+                emit(text)
         except Exception:
             # client went away mid-stream: free the slot instead of decoding the
             # abandoned request to max_tokens
-            req.cancel()
+            if req is not None:
+                req.cancel()
             raise
-        if req.error is not None:
+        if req is not None and req.error is not None:
             raise req.error
         if qstreamer.stopped:
             finish[0] = "stop"
-        elif req.finish == "deadline":
+        elif req is not None and req.finish == "deadline":
             # deadline expired mid-generation WITH partial output: deliver
             # what exists, finish_reason says why it stopped early
             finish[0] = "deadline"
-        _observe_done(t_start, ttft, req.stats.generated_tokens, finish[0])
+        gen_tokens = req.stats.generated_tokens if req is not None else 0
+        if resume and req is not None:
+            _RESUME_REUSED.inc(req.stats.reused_tokens)
+        _observe_done(t_start, ttft, gen_tokens, finish[0])
         return "".join(pieces), finish[0]
 
     engine = state.engine
+    jpending: list[int] = []  # tokens since the last flush (journal pairing)
 
     def emit_bytes(d: bytes):
         text = d.decode("utf-8", errors="replace")
         pieces.append(text)
+        if journal is not None:
+            journal["toks"].extend(jpending)
+            journal["n"] += len(jpending)
+        jpending.clear()
         emit(text)
 
     streamer = TokenStreamer(detector, lambda t: tok.decode_piece(0, t), emit_bytes)
+
+    def on_token(t: int):
+        jpending.append(t)
+        streamer.on_token(t)
+
     # single-engine counterpart of the scheduler-enforced deadline: checked
     # per decoded token via stop_check, finish reason "deadline", partial
     # output delivered (granularity one token vs the scheduler's ~one
     # dispatch; generation time only — the do_POST lock wait precedes
-    # t_start in this mode)
-    deadline_t = (t_start + state.request_deadline
-                  if state.request_deadline else None)
+    # t_start in this mode). eff_deadline folds in the X-Deadline-Ms
+    # remaining-budget header a durable router relays across resumes.
+    deadline_t = t_start + eff_deadline if eff_deadline else None
 
     def stop_or_deadline(t):
         if streamer.stop_check(t):
@@ -387,16 +522,36 @@ def run_completion(state: ApiState, body: dict, emit):
             return True
         return False
 
+    # resume re-feed: same contract as the batched path — delivered tokens
+    # re-emit their text and arm the stop detector's cross-boundary state
+    for t in resume:
+        if streamer.stopped:
+            break
+        on_token(t)
+    prompt_full = prompt + resume
+    if streamer.stopped:
+        _observe_done(t_start, ttft, 0, "stop")
+        return "".join(pieces), "stop"
+    if resume and max_tokens == 0:
+        # original run ended at its limit right after the last delivered
+        # token: the re-fed text IS the completion — no engine work
+        _observe_done(t_start, ttft, 0, "length")
+        return "".join(pieces), "length"
+
     # Prefix reuse (cache/single_slot.py): rewind pos over the resident
     # conversation's common prefix (for paged engines, begin() also restores
     # the hot ring from the host store via Engine.seek) and/or seed cache rows
     # from the cross-conversation block pool — prefill covers only the rest.
-    reuse = state.cache.begin(prompt)
-    delta_prompt = prompt[reuse:]
+    # A resumed request reuses against prompt ⊕ delivered: the prompt half is
+    # usually cached, so resume cost ≈ one delivered-suffix prefill.
+    reuse = state.cache.begin(prompt_full)
+    delta_prompt = prompt_full[reuse:]
+    if resume:
+        _RESUME_REUSED.inc(reuse)
 
     try:
         out, _stats = engine.generate_with(delta_prompt, max_tokens, sampler,
-                                           on_token=streamer.on_token,
+                                           on_token=on_token,
                                            stop_check=stop_or_deadline,
                                            device_loop_chunk=state.device_loop_chunk,
                                            speculative_k=state.speculative_k,
@@ -405,7 +560,7 @@ def run_completion(state: ApiState, body: dict, emit):
                                            # delta_prompt alone would starve
                                            # prompt-lookup of exactly the
                                            # repetitive history it draws from
-                                           history_tokens=prompt)
+                                           history_tokens=prompt_full)
     except Exception:
         # KV may hold a half-written new conversation; drop the reuse index entirely
         state.cache.invalidate()
@@ -414,7 +569,7 @@ def run_completion(state: ApiState, body: dict, emit):
         finish[0] = "stop"
     # only tokens whose KV was actually written are reusable (a final stop token is
     # sampled but never inferred, so engine.pos may be one short of prompt+out)
-    state.cache.end((prompt + out)[: engine.pos])
+    state.cache.end((prompt_full + out)[: engine.pos])
     _observe_done(t_start, ttft, len(out), finish[0])
     return "".join(pieces), finish[0]
 
@@ -441,6 +596,11 @@ def _map_error(e: Exception) -> tuple[int, str, float | None]:
     failures on caller input) stays a 400."""
     if isinstance(e, EngineSaturated):
         return 503, "overloaded_error", getattr(e, "retry_after", 1.0)
+    if isinstance(e, EngineWedged):
+        # the supervisor failed this request while recovering a hung engine:
+        # retriable by contract — a durable router resumes it elsewhere, a
+        # plain client may simply retry after the recovery window
+        return 503, "server_wedged", 1.0
     if isinstance(e, EngineClosed):  # covers EngineDraining
         return 503, "server_shutting_down", None
     if isinstance(e, DeadlineExceeded):
@@ -520,12 +680,21 @@ class Handler(BaseHTTPRequestHandler):
             # "unhealthy" when the batch scheduler thread died.
             be = self.state.batch_engine
             alive = be is None or be.scheduler_alive()
+            sup = self.state.supervisor
             replica = _load_block(self.state)  # identity+load for routers
             if self.state.draining or (be is not None and be.draining):
                 self._json(503, {"status": "draining", "replica": replica})
             elif not alive:
                 self._json(503, {"status": "unhealthy",
                                  "reason": "scheduler thread dead",
+                                 "replica": replica})
+            elif sup is not None and not sup.healthy:
+                # the supervisor caught a wedged engine: stay out of fleet
+                # rotation for the recovery window (or permanently, state
+                # "failed") so the router resumes this replica's journaled
+                # requests elsewhere (docs/ROBUSTNESS.md)
+                self._json(503, {"status": "unhealthy",
+                                 "reason": f"supervisor: engine {sup.state}",
                                  "replica": replica})
             else:
                 self._json(200, {"status": "ok", "replica": replica})
@@ -593,6 +762,32 @@ class Handler(BaseHTTPRequestHandler):
             return
         stream = bool(body.get("stream", False))
         state = self.state
+        # remaining client deadline (docs/FLEET.md): a durable router relays
+        # the ORIGINAL X-Deadline-Ms minus elapsed time across every retry
+        # and resume, so the request can never silently outlive the budget
+        # the client set; an already-expired budget is an immediate 408
+        deadline_s = None
+        hdr = self.headers.get("X-Deadline-Ms")
+        if hdr is not None:
+            try:
+                v = float(hdr)
+                if v != v or v in (float("inf"), float("-inf")):
+                    raise ValueError(hdr)  # NaN/inf pass <=0 checks below
+                deadline_s = max(v, 0.0) / 1000.0
+            except ValueError:
+                self._error(400, "X-Deadline-Ms must be a finite number "
+                            "(ms)", "invalid_request_error")
+                return
+            if deadline_s <= 0.0:
+                self._error(408, "client deadline already expired",
+                            "timeout_error")
+                return
+        # durable journal mode (docs/FLEET.md "Resume protocol"): the router
+        # asks for token ids alongside each SSE text delta so its journal
+        # can re-submit the request mid-stream; OpenAI clients ignore the
+        # extra field, and it is absent without the header
+        jstate = ({"toks": [], "n": 0}
+                  if self.headers.get("X-Dllama-Journal") else None)
         # request identity (docs/OBSERVABILITY.md "Request tracing"): adopt
         # the inbound W3C traceparent (the fleet router stamps one on every
         # proxied hop; any W3C-speaking client works too) or originate a
@@ -627,19 +822,36 @@ class Handler(BaseHTTPRequestHandler):
                     if not started[0]:
                         _start_stream()
                     payload = _chunk_payload(state, completion_id, {"content": text}, None)
+                    if jstate is not None:
+                        # token ids whose text THIS chunk carries + the
+                        # cumulative delivered count — the durable router's
+                        # journal entry (stripped before client relay)
+                        payload["dllama"] = {"n": jstate["n"],
+                                             "toks": jstate["toks"]}
+                        jstate["toks"] = []
                     self._write_chunk(f"data: {json.dumps(payload)}\n\n".encode())
 
                 try:
-                    _text, finish = run_completion(state, body, emit)
+                    _text, finish = run_completion(state, body, emit,
+                                                   journal=jstate,
+                                                   deadline_s=deadline_s)
                 except Exception as e:
                     _flight_error(rid, e)
                     if not started[0]:  # nothing sent: honest status code
                         self._mapped_error(e, rid)
                         return
-                    # mid-stream: error as SSE event, then terminate
+                    # mid-stream: error as SSE event, then terminate. The
+                    # `retriable` flag is the durable router's failover
+                    # switch (docs/FLEET.md): True = the replica failed
+                    # around an innocent request (wedged/closed/engine
+                    # fault) and the journal may resume it elsewhere;
+                    # False = deterministic, resuming would fail again.
+                    code, etype, _ra = _map_error(e)
                     self._write_chunk(
                         ("data: " + json.dumps({"error": {
-                            "message": str(e), "type": "server_error"}})
+                            "message": str(e), "type": etype,
+                            "code": code,
+                            "retriable": retriable(e)}})
                          + "\n\n").encode())
                     self._write_chunk(b"data: [DONE]\n\n")
                     self._write_chunk(b"")
@@ -655,7 +867,9 @@ class Handler(BaseHTTPRequestHandler):
                 self._write_chunk(b"")
             else:
                 try:
-                    text, finish = run_completion(state, body, lambda _t: None)
+                    text, finish = run_completion(state, body,
+                                                  lambda _t: None,
+                                                  deadline_s=deadline_s)
                     self._json(200, _completion_payload(state, text, finish,
                                                         rid),
                                {"X-Request-Id": rid,
@@ -678,7 +892,9 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           prefix_cache_q80: bool = False,
           request_deadline: float = 0.0, flight_requests: int = 256,
           slow_log: str | None = None,
-          slow_threshold: float = 1.0) -> ThreadingHTTPServer:
+          slow_threshold: float = 1.0,
+          supervisor_threshold: float = 0.0,
+          supervisor_poll: float = 1.0) -> ThreadingHTTPServer:
     # batched speculative decoding lives in the BatchEngine scheduler
     # (construct it with speculative=K); speculative_k here drives only the
     # sequential engine's per-request verify loop. Guard EVERY caller, not
@@ -700,7 +916,7 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
                      prefix_cache_q80=prefix_cache_q80,
                      request_deadline=request_deadline)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
-    server = ThreadingHTTPServer((host, port), handler)
+    server = QuietServer((host, port), handler)
     server.api_state = state  # drain controller / tests reach the state here
     # bound port is only known now (port=0 binds ephemeral in tests/benches)
     state.replica_id = f"{host}:{server.server_address[1]}"
@@ -716,6 +932,19 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
                        slow_threshold=slow_threshold)
     install_process_metrics()
     trace.set_process_name(f"api_server {state.replica_id}")
+    if supervisor_threshold > 0 and batch_engine is not None:
+        # hung-engine supervision (docs/ROBUSTNESS.md): act on the dispatch
+        # watchdog instead of only exporting it — wedged past the threshold
+        # ⇒ fail in-flight retriable, re-initialize the backend, and keep
+        # /healthz unhealthy for the window so the fleet resumes elsewhere
+        from ..resilience.supervisor import EngineSupervisor
+
+        state.supervisor = EngineSupervisor(
+            batch_engine, threshold=supervisor_threshold,
+            poll=supervisor_poll).start()
+        print(f"🛡️  supervisor armed: dispatch hang > "
+              f"{supervisor_threshold:.0f}s fails in-flight (retriable) and "
+              "re-initializes the backend")
     print(f"🟢 dllama-api listening on {host}:{port}")
     return server
 
@@ -841,6 +1070,20 @@ def main(argv=None) -> None:
     p.add_argument("--slow-threshold", type=float, default=1.0, metavar="S",
                    help="E2E seconds over which a request lands in "
                         "--slow-log (default 1.0)")
+    p.add_argument("--supervisor-threshold", type=float, default=0.0,
+                   metavar="S",
+                   help="hung-engine supervisor (--batch > 1;"
+                        " docs/ROBUSTNESS.md): when no device dispatch "
+                        "completes for S seconds while work is in flight, "
+                        "fail in-flight requests with a RETRIABLE error, "
+                        "re-initialize the backend, and flip /healthz "
+                        "unhealthy so a fleet router resumes the requests "
+                        "elsewhere (0 = observe-only watchdog, the "
+                        "pre-supervisor behavior). Size well above the "
+                        "slowest legitimate dispatch incl. cold compiles")
+    p.add_argument("--supervisor-poll", type=float, default=1.0, metavar="S",
+                   help="supervisor watchdog sampling period (detection "
+                        "latency is threshold + poll)")
     args = p.parse_args(argv)
     from .dllama import dump_trace, install_trace
 
@@ -907,7 +1150,9 @@ def main(argv=None) -> None:
                    request_deadline=args.request_deadline,
                    flight_requests=args.flight_requests,
                    slow_log=args.slow_log,
-                   slow_threshold=args.slow_threshold)
+                   slow_threshold=args.slow_threshold,
+                   supervisor_threshold=args.supervisor_threshold,
+                   supervisor_poll=args.supervisor_poll)
     # SIGTERM -> graceful drain (docs/ROBUSTNESS.md): /healthz flips to
     # draining, admissions stop, in-flight requests finish, then shutdown
     install_sigterm_drain(server, server.api_state, args.drain_timeout)
@@ -916,6 +1161,8 @@ def main(argv=None) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if server.api_state.supervisor is not None:
+            server.api_state.supervisor.stop()
         if batch_engine is not None:
             # idempotent after a SIGTERM drain (close() re-entry is a no-op
             # walk over already-freed slots); a Ctrl-C exit aborts in-flight

@@ -45,7 +45,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="affinity trie capacity (LRU-evicted beyond N)")
     p.add_argument("--retries", type=int, default=2, metavar="N",
                    help="max failover tries on a DIFFERENT replica for "
-                        "requests that failed before their first byte")
+                        "requests that failed before their first byte; with "
+                        "durable routing also the consecutive-fruitless-try "
+                        "budget per mid-stream resume round")
+    p.add_argument("--no-durable", action="store_true",
+                   help="disable durable requests (docs/FLEET.md \"Resume "
+                        "protocol\"): by default every completion is "
+                        "journaled (params + pinned seed + delivered "
+                        "tokens) and a mid-stream replica failure is "
+                        "survived by resuming on a surviving replica with "
+                        "byte-identical continuation and exactly-once "
+                        "delivery; this flag reverts to verbatim "
+                        "pass-through where mid-stream failures surface as "
+                        "SSE error events")
     p.add_argument("--proxy-timeout", type=float, default=120.0, metavar="S",
                    help="per-try socket timeout (connect and each read)")
     p.add_argument("--seed", type=int, default=0,
@@ -71,7 +83,8 @@ def main(argv=None) -> None:
         args.replicas, host=args.host, port=args.port, policy=args.routing,
         poll_interval=args.poll_interval, poll_timeout=args.poll_timeout,
         block_bytes=args.block_bytes, affinity_nodes=args.affinity_nodes,
-        retries=args.retries, try_timeout=args.proxy_timeout, seed=args.seed)
+        retries=args.retries, try_timeout=args.proxy_timeout, seed=args.seed,
+        durable=not args.no_durable)
 
     def _on_term(signum, frame):
         # the router holds no request state worth draining beyond in-flight

@@ -1,0 +1,225 @@
+"""Durable-request journal: the router-side half of mid-stream failover.
+
+A replica death used to be survivable only BEFORE output flowed — once the
+client had bytes, the router's only honest move was an SSE error event
+(fleet/router.py PR 6). The journal removes that cliff (docs/FLEET.md
+"Resume protocol"): every in-flight completion the durable router proxies is
+recorded here — the request body with its sampling seed PINNED, the adopted
+trace context, the delivered generated-token ids the serving replica reports
+in-band (the `dllama` field `X-Dllama-Journal` asks for), and the exact
+number of content characters relayed to the client. When a replica dies
+mid-stream the router re-submits the entry to a surviving replica with a
+`resume` payload; the replica prefills prompt ⊕ delivered-tokens (mostly a
+radix prefix-cache hit), fast-forwards its sampler past the consumed coins,
+and re-emits the stream from generated-token zero — byte-identical to the
+uninterrupted run by the engine's RNG/prefill guarantees — while the router
+splices: it skips exactly `sent_chars` characters before relaying again, so
+the client sees one uninterrupted stream with exactly-once delivery.
+
+The journal is in-memory: the DURABILITY DOMAIN is "requests outlive the
+replica serving them", not the router process itself (a router crash drops
+the TCP connections it fronts regardless of any journal). Entries live only
+while their request is in flight and are dropped at completion.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..obs import metrics
+
+__all__ = ["JournalEntry", "RequestJournal", "iter_sse_data", "parse_chunk",
+           "pin_seed"]
+
+_INFLIGHT = metrics.gauge(
+    "router_journal_inflight",
+    "Durable requests currently journaled (in flight through the router)")
+_RESUMED = metrics.counter(
+    "router_resumed_requests_total",
+    "Requests resumed on another replica after a mid-stream failure "
+    "(counted once per request, however many times it moved)")
+_RESUME_ATTEMPTS = metrics.counter(
+    "router_resume_attempts_total",
+    "Mid-stream failover re-submits issued (one per replica move)")
+_RESUME_TOKENS = metrics.counter(
+    "router_resume_tokens_total",
+    "Journaled generated tokens carried by resume re-submits")
+_DURABLE_FAILED = metrics.counter(
+    "router_durable_failed_total",
+    "Durable requests that exhausted every resume candidate and surfaced a "
+    "client-visible failure")
+
+
+def pin_seed(body: dict) -> dict:
+    """Pin the sampling seed BEFORE the first proxy try: the replica defaults
+    a missing/null seed to wall-clock time, so a retried or resumed request
+    would draw a different xorshift* stream and diverge. One journal-owned
+    seed makes every re-submit byte-deterministic (greedy requests are
+    deterministic regardless; the pin is harmless there)."""
+    if body.get("seed") is None:
+        body = dict(body)
+        body["seed"] = int.from_bytes(os.urandom(4), "big") >> 1
+    return body
+
+
+@dataclass
+class JournalEntry:
+    """One in-flight durable request. Mutated only by its handler thread."""
+
+    rid: str                      # router-side journal key
+    body: dict                    # seed-pinned request (WITHOUT resume field)
+    stream: bool                  # client asked for SSE
+    deadline_ms: float | None     # original X-Deadline-Ms budget, if any
+    t0: float = field(default_factory=time.perf_counter)
+    tokens: list[int] = field(default_factory=list)  # delivered token ids
+    sent_chars: int = 0           # content chars relayed to the client
+    # accumulated spliced content for NON-streaming clients (nothing reaches
+    # the client until completion, so the text must survive replica moves)
+    parts: list[str] = field(default_factory=list)
+    completion_id: str | None = None  # first upstream id, kept across moves
+    model: str | None = None      # first upstream model (final payloads)
+    replicas: list[str] = field(default_factory=list)  # serving history
+    resumes: int = 0              # successful mid-stream moves
+    finish: str | None = None
+
+    def upstream_body(self) -> dict:
+        """Body for the next upstream try: always streaming (the journal
+        needs in-band tokens even for non-streaming clients) plus the resume
+        payload once anything was delivered."""
+        b = dict(self.body)
+        b["stream"] = True
+        if self.tokens:
+            b["resume"] = {"tokens": list(self.tokens)}
+        return b
+
+    def record_tokens(self, info: dict) -> None:
+        """Fold one chunk's `dllama` journal field in. `n` is the cumulative
+        delivered count INCLUDING the chunk's `toks`; a resumed upstream
+        re-counts from zero over tokens this journal already holds, so only
+        the tail beyond the current length is appended (idempotent under
+        replays)."""
+        toks = info.get("toks") or []
+        try:
+            n = int(info.get("n", 0))
+        except (TypeError, ValueError):
+            return
+        have = len(self.tokens)
+        if n > have and len(toks) >= n - have:
+            self.tokens.extend(int(t) for t in toks[len(toks) - (n - have):])
+
+    def splice(self, text: str, upstream_chars: int) -> str:
+        """Exactly-once delivery: `text` is one upstream delta whose content
+        ends at cumulative position `upstream_chars` in the upstream's
+        from-zero stream; return only the part the client has not seen."""
+        start = upstream_chars - len(text)
+        if upstream_chars <= self.sent_chars:
+            return ""
+        new = text[max(self.sent_chars - start, 0):]
+        self.sent_chars += len(new)
+        return new
+
+    def remaining_deadline_ms(self) -> float | None:
+        """X-Deadline-Ms for the NEXT hop: the client's original budget minus
+        elapsed wall time — a resumed request must not outlive the deadline
+        the client set (0 = already expired; caller fails the request)."""
+        if self.deadline_ms is None:
+            return None
+        rem = self.deadline_ms - (time.perf_counter() - self.t0) * 1000.0
+        return max(rem, 0.0)
+
+
+class RequestJournal:
+    """Bounded live table of in-flight durable requests."""
+
+    def __init__(self, max_inflight: int = 4096):
+        self.max_inflight = max_inflight
+        self._live: dict[str, JournalEntry] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def open(self, body: dict, stream: bool,
+             deadline_ms: float | None) -> JournalEntry | None:
+        """Journal a new request (seed pinned here). None when the table is
+        full — the caller should fall back to the non-durable proxy path
+        rather than shed (an unjournaled request is still served, it just
+        cannot survive a mid-stream failure)."""
+        with self._lock:
+            if len(self._live) >= self.max_inflight:
+                return None
+            self._seq += 1
+            rid = f"jrn-{self._seq:08d}"
+            entry = JournalEntry(rid, pin_seed(body), stream, deadline_ms)
+            self._live[rid] = entry
+            _INFLIGHT.set(len(self._live))
+        return entry
+
+    def note_resume(self, entry: JournalEntry) -> None:
+        if entry.resumes == 0:
+            _RESUMED.inc()
+        entry.resumes += 1
+        _RESUME_ATTEMPTS.inc()
+        _RESUME_TOKENS.inc(len(entry.tokens))
+
+    def close(self, entry: JournalEntry, finish: str | None) -> None:
+        entry.finish = finish
+        if finish == "failed":
+            _DURABLE_FAILED.inc()
+        with self._lock:
+            self._live.pop(entry.rid, None)
+            _INFLIGHT.set(len(self._live))
+
+    def abandon(self, entry: JournalEntry) -> None:
+        """Last-resort cleanup for an entry whose handler unwound without
+        reaching a close() — typically the CLIENT dropped the connection
+        mid-relay (a write raised out of the proxy loop). Idempotent and a
+        no-op after a real close; without it every abandoned SSE stream
+        would leak its entry until the table filled and durability silently
+        disabled fleet-wide."""
+        with self._lock:
+            if self._live.pop(entry.rid, None) is None:
+                return
+            _INFLIGHT.set(len(self._live))
+        if entry.finish is None:
+            entry.finish = "abandoned"
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+
+def iter_sse_data(resp):
+    """Incrementally yield the payload string of every `data: ...` SSE event
+    from an http.client response (readline honors chunked decoding, so each
+    event is surfaced the moment its bytes arrive — the router relays tokens
+    with no end-of-stream buffering). Multi-line data fields are joined per
+    the SSE spec; [DONE] is yielded verbatim for the caller to recognize."""
+    data_lines: list[str] = []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.decode("utf-8", "replace").rstrip("\r\n")
+        if line == "":
+            if data_lines:
+                yield "\n".join(data_lines)
+                data_lines = []
+            continue
+        if line.startswith("data:"):
+            data_lines.append(line[5:].lstrip(" "))
+    if data_lines:  # stream cut mid-event: surface what arrived
+        yield "\n".join(data_lines)
+
+
+def parse_chunk(data: str):
+    """Parse one SSE data payload into a dict, or None for [DONE]/garbage."""
+    if data == "[DONE]":
+        return None
+    try:
+        obj = json.loads(data)
+    except ValueError:
+        return None
+    return obj if isinstance(obj, dict) else None

@@ -3,7 +3,9 @@
 The replica set is configuration (`--replica host:port`, repeated) — there is
 no discovery protocol — but *rotation* is dynamic: a background poller GETs
 every replica's `/healthz` (the identity/load block api_server publishes) on
-an interval and replicas leave rotation the moment they report `draining`
+an interval — unreachable replicas on a per-replica exponential backoff
+with jitter instead, with a capped down log — and replicas leave rotation
+the moment they report `draining`
 (SIGTERM graceful drain, docs/ROBUSTNESS.md), report `unhealthy` (scheduler
 thread dead), or stop answering; they rejoin automatically on the first clean
 poll after recovery. The proxy path can also eject a replica synchronously
@@ -23,6 +25,7 @@ thread itself must survive anything a poll raises.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -70,6 +73,13 @@ class Replica:
     consecutive_failures: int = 0
     last_ok: float = 0.0
     hash_warned: bool = False  # rate-limits the model-mismatch warning
+    # per-replica poll backoff (unreachable replicas only): the background
+    # poller skips this replica until next_poll_t — exponential with jitter,
+    # so a dead replica costs ~one timed-out connect per backoff_cap instead
+    # of one per poll_interval (and N dead replicas don't re-probe in sync)
+    next_poll_t: float = 0.0       # monotonic; 0 = poll normally
+    down_since: float = 0.0        # monotonic of the first failed poll
+    last_down_log: float = 0.0     # rate-limits the "still down" line
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def __post_init__(self):
@@ -99,7 +109,8 @@ def parse_addr(addr: str) -> tuple[str, int]:
 
 class Membership:
     def __init__(self, addrs: list[str], poll_interval: float = 2.0,
-                 poll_timeout: float = 2.0):
+                 poll_timeout: float = 2.0, backoff_cap: float = 30.0,
+                 down_log_interval: float = 30.0):
         if not addrs:
             raise ValueError("router needs at least one --replica host:port")
         self.replicas = [Replica(*parse_addr(a)) for a in addrs]
@@ -107,6 +118,12 @@ class Membership:
             raise ValueError("duplicate replica addresses")
         self.poll_interval = poll_interval
         self.poll_timeout = poll_timeout
+        # exponential poll backoff for unreachable replicas, jittered so a
+        # fleet of routers (or several dead replicas) never re-probes in
+        # lockstep; capped so a recovered replica rejoins within backoff_cap
+        self.backoff_cap = backoff_cap
+        self.down_log_interval = down_log_interval
+        self._backoff_rng = random.Random(0xD11A)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._fleet_hash: str | None = None
@@ -130,14 +147,21 @@ class Membership:
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_interval):
-            self.poll_once()
+            self.poll_once(force=False)
 
     # ------------------------------------------------------------------
     # polling
     # ------------------------------------------------------------------
 
-    def poll_once(self) -> None:
+    def poll_once(self, force: bool = True) -> None:
+        """Poll the fleet. `force=True` (the default — explicit callers mean
+        "poll NOW") ignores per-replica backoff; the background loop passes
+        False so unreachable replicas are probed on their backoff schedule
+        instead of every interval."""
+        now = time.monotonic()
         for rep in self.replicas:
+            if not force and rep.next_poll_t > now:
+                continue  # unreachable replica inside its backoff window
             self._poll(rep)
         _IN_ROTATION.set(len(self.in_rotation()))
 
@@ -158,7 +182,14 @@ class Membership:
             rep.status = "unreachable"
             rep.consecutive_failures += 1
             _POLLS.labels(outcome="unreachable").inc()
+            self._note_unreachable(rep)
             return
+        if rep.down_since > 0.0:  # reachable again: reset backoff, say so once
+            print(f"🟢 replica {rep.id} reachable again after "
+                  f"{time.monotonic() - rep.down_since:.0f}s down")
+        rep.next_poll_t = 0.0
+        rep.down_since = 0.0
+        rep.last_down_log = 0.0
         status = body.get("status",
                           "ok" if resp.status == 200 else "unhealthy")
         block = body.get("replica") or {}
@@ -192,6 +223,32 @@ class Membership:
                 else:
                     rep.hash_warned = False
         _POLLS.labels(outcome=status).inc()
+
+    def _note_unreachable(self, rep: Replica) -> None:
+        """Failure bookkeeping: exponential backoff with jitter on the next
+        background poll (2^k × poll_interval, capped, ×uniform[0.5, 1.0)) and
+        a CAPPED down log — first failure logs immediately, then at most one
+        line per down_log_interval, so a dead replica cannot spam one line
+        per poll for hours."""
+        now = time.monotonic()
+        # exponent capped BEFORE exponentiating: a replica down for hours
+        # reaches failure counts where 2**k overflows float multiplication
+        # and would kill the poller thread (2**32 × any interval is already
+        # far past every cap)
+        exp = min(max(rep.consecutive_failures - 1, 0), 32)
+        backoff = min(self.poll_interval * (2 ** exp), self.backoff_cap)
+        rep.next_poll_t = now + backoff * (0.5 + 0.5
+                                           * self._backoff_rng.random())
+        if rep.down_since == 0.0:
+            rep.down_since = now
+            rep.last_down_log = now
+            print(f"🔴 replica {rep.id} unreachable; polling with backoff "
+                  f"(cap {self.backoff_cap:.0f}s)")
+        elif now - rep.last_down_log >= self.down_log_interval:
+            rep.last_down_log = now
+            print(f"🔴 replica {rep.id} still unreachable "
+                  f"({now - rep.down_since:.0f}s, "
+                  f"{rep.consecutive_failures} failed polls)")
 
     # ------------------------------------------------------------------
     # rotation / selection

@@ -42,7 +42,9 @@ from urllib.parse import parse_qs
 from ..obs import metrics, reqctx, trace
 from ..obs.process import install_process_metrics
 from ..resilience import faults
+from ..resilience.quiet_http import QuietServer
 from .affinity import AffinityMap
+from .journal import RequestJournal, iter_sse_data, parse_chunk
 from .membership import Membership, Replica
 
 __all__ = ["RouterState", "serve_router", "close_router", "merge_prometheus",
@@ -80,7 +82,8 @@ class RouterState:
                  block_bytes: int = 64, affinity_nodes: int = 8192,
                  retries: int = 2, try_timeout: float = 120.0,
                  scrape_timeout: float = 3.0, key_bytes: int = 4096,
-                 seed: int = 0):
+                 seed: int = 0, durable: bool = True,
+                 journal_inflight: int = 4096):
         assert policy in ("affinity", "random"), policy
         self.membership = membership
         self.affinity = AffinityMap(block_bytes=block_bytes,
@@ -90,6 +93,11 @@ class RouterState:
         self.try_timeout = try_timeout
         self.scrape_timeout = scrape_timeout
         self.key_bytes = key_bytes
+        # durable requests (docs/FLEET.md "Resume protocol"): journal every
+        # in-flight completion so a mid-stream replica failure is survived by
+        # resuming on another replica instead of surfaced as an SSE error
+        self.durable = durable
+        self.journal = RequestJournal(max_inflight=journal_inflight)
         self._rng = random.Random(seed)
         self._rr = 0  # round-robin clock for least-loaded ties
         self._lock = threading.Lock()
@@ -452,6 +460,20 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- POST
 
+    def _deadline_ms(self) -> float | None:
+        """Parse the client's X-Deadline-Ms budget (None = no deadline;
+        ValueError surfaces as a 400 in the caller). Non-finite values must
+        be rejected HERE: a NaN would pass every downstream `<= 0` check
+        and then blow up int() conversions inside the failover loop, where
+        the blast radius is replica ejections, not a clean 400."""
+        hdr = self.headers.get("X-Deadline-Ms")
+        if hdr is None:
+            return None
+        v = float(hdr)
+        if v != v or v in (float("inf"), float("-inf")):
+            raise ValueError(f"non-finite deadline {hdr!r}")
+        return max(v, 0.0)
+
     def do_POST(self):
         if self.path not in ("/v1/chat/completions", "/chat/completions"):
             self._error(404, f"Unknown route: {self.path}",
@@ -468,16 +490,54 @@ class RouterHandler(BaseHTTPRequestHandler):
             self._error(400, "Request body is not valid JSON",
                         "invalid_request_error")
             return
+        try:
+            deadline_ms = self._deadline_ms()
+        except ValueError:
+            self._error(400, "X-Deadline-Ms must be a number (ms)",
+                        "invalid_request_error")
+            return
         # trace origination (docs/OBSERVABILITY.md "Request tracing"): adopt
         # the client's W3C traceparent or start a new trace; every proxy try
         # is its own hop (fresh span id, same trace id) stamped onto the
         # upstream request, so the replica's engine spans and this router's
         # proxy span share one trace id in the merged fleet trace
         ctx = reqctx.adopt(self.headers.get("traceparent"))
+        if state.durable and "resume" not in body:
+            # durable path (docs/FLEET.md "Resume protocol"): journal the
+            # request and survive mid-stream replica failures by resuming on
+            # another replica with exactly-once splicing. A client-supplied
+            # resume payload is passed through the plain path untouched (the
+            # caller IS a durability layer; double-journaling would fight
+            # it). A full journal degrades to the plain path too — served,
+            # just not failover-protected.
+            entry = state.journal.open(
+                body, stream=bool(body.get("stream", False)),
+                deadline_ms=deadline_ms)
+            if entry is not None:
+                self._durable_post(entry, ctx)
+                return
+        self._plain_post(body, raw, ctx, deadline_ms)
+
+    def _plain_post(self, body: dict, raw: bytes, ctx, deadline_ms):
+        """The pre-durable proxy loop: verbatim pass-through, pre-first-byte
+        failover only, mid-stream failures surfaced honestly."""
+        state = self.state
+        t0 = time.perf_counter()
         key = state.affinity_key(body)
         tried: set[str] = set()
         last_503: tuple[bytes, str, str | None] | None = None
         for attempt in range(1 + state.retries):
+            extra = None
+            if deadline_ms is not None:
+                # propagate the REMAINING budget, not the original: a retry
+                # that re-sent the full deadline would let the fleet spend
+                # attempts × deadline on a request the client abandoned
+                rem = deadline_ms - (time.perf_counter() - t0) * 1000.0
+                if rem <= 0.0:
+                    self._error(408, "client deadline expired during "
+                                "failover", "timeout_error")
+                    return
+                extra = {"X-Deadline-Ms": str(int(rem) or 1)}
             rep, reason = state.pick(key, tried)
             if rep is None:
                 break
@@ -490,7 +550,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                     trace.span("router.proxy",
                                {"replica": rep.id, "reason": reason,
                                 "attempt": attempt}):
-                outcome, info = self._proxy_try(rep, raw, key, hop)
+                outcome, info = self._proxy_try(rep, raw, key, hop, extra)
             if outcome == "delivered" or outcome == "aborted":
                 return
             if info is not None:  # a relayable 503 from this replica
@@ -510,11 +570,312 @@ class RouterHandler(BaseHTTPRequestHandler):
                         f"{len(state.membership.in_rotation())} in rotation)",
                         "overloaded_error", retry_after=retry_after)
 
+    # ---------------------------------------------------- durable proxy
+
+    def _durable_post(self, entry, ctx) -> None:
+        """Journaled proxy loop (docs/FLEET.md "Resume protocol"): the
+        upstream leg ALWAYS streams with in-band token journaling, whatever
+        the client asked for, so every delivered token is recorded the
+        moment it flows. A mid-stream replica failure re-submits the journal
+        to a surviving replica with a `resume` payload; splice() gives the
+        client exactly-once delivery, so the failover is invisible. The
+        failover budget is `retries` tries per no-progress round — a try
+        that advanced the stream resets the round (a long generation may
+        outlive several replicas), so only consecutive fruitless tries give
+        up."""
+        try:
+            self._durable_post_inner(entry, ctx)
+        finally:
+            # a client that dropped the connection mid-relay unwinds the
+            # handler through a write error before any close() — reclaim
+            # the entry (no-op after a normal close) or abandoned streams
+            # would fill the journal and silently disable durability
+            self.state.journal.abandon(entry)
+
+    def _durable_post_inner(self, entry, ctx) -> None:
+        state = self.state
+        key = state.affinity_key(entry.body)
+        client_started = [False]
+        tried: set[str] = set()
+        fruitless = 0
+        last_503: tuple[bytes, str, str | None] | None = None
+        attempt = 0
+        while fruitless <= state.retries:
+            rem = entry.remaining_deadline_ms()
+            if rem is not None and rem <= 0.0:
+                self._durable_fail(entry, client_started, 408,
+                                   "client deadline expired during failover",
+                                   "timeout_error")
+                return
+            rep, reason = state.pick(key, tried)
+            if rep is None:
+                break
+            tried.add(rep.id)
+            _ROUTES.labels(reason=reason).inc()
+            if attempt == 1:
+                _RETRIES.inc()
+            attempt += 1
+            progress0 = (len(entry.tokens), entry.sent_chars)
+            hop = ctx.child()
+            with reqctx.use(hop), \
+                    trace.span("router.proxy",
+                               {"replica": rep.id, "reason": reason,
+                                "attempt": attempt - 1, "durable": True,
+                                "resume_tokens": len(entry.tokens)}):
+                outcome, info = self._durable_try(rep, entry, key, hop,
+                                                  client_started)
+            if outcome in ("done", "fatal"):
+                state.journal.close(
+                    entry, entry.finish if outcome == "done" else "error")
+                return
+            if info is not None:
+                last_503 = info
+            if (len(entry.tokens), entry.sent_chars) != progress0:
+                # the replica served this request for a while before dying:
+                # new failover round — every OTHER replica is a candidate
+                # again (it may have rejoined rotation since)
+                fruitless = 1
+                tried = {rep.id}
+            else:
+                fruitless += 1
+        # candidates exhausted with no completion: surface honestly
+        state.journal.close(entry, "failed")
+        retry_after = state.membership.poll_interval
+        if client_started[0]:
+            self._sse_error_event(
+                f"no replica could resume the stream ({len(tried)} tried)",
+                "server_error")
+        elif last_503 is not None:
+            data, ctype, ra = last_503
+            self._raw(503, ctype, data,
+                      {"Retry-After": ra or str(max(int(retry_after), 1))})
+        else:
+            self._error(503, "no replica available "
+                        f"({len(tried)} tried, "
+                        f"{len(state.membership.in_rotation())} in rotation)",
+                        "overloaded_error", retry_after=retry_after)
+
+    def _durable_try(self, rep: Replica, entry, key: bytes, hop,
+                     client_started: list):
+        """One journaled upstream try. Returns (outcome, relayable_503):
+        "done" — the completion reached the client (stream terminated or
+        JSON sent); "fatal" — a deterministic error was relayed, do not
+        retry; "retry" — the replica failed around the request (connect,
+        read, 503, or a retriable in-stream error); anything already
+        delivered stays journaled for the next candidate."""
+        state = self.state
+        mem = state.membership
+        mem.inflight_inc(rep)
+        _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+        if entry.tokens or entry.sent_chars:
+            state.journal.note_resume(entry)
+        conn = None
+        t0 = time.perf_counter()
+        try:
+            try:
+                faults.fire("router.proxy", replica=rep.id)
+                headers = {"Content-Type": "application/json",
+                           "X-Dllama-Journal": "1",
+                           "traceparent": hop.to_traceparent()}
+                rem = entry.remaining_deadline_ms()
+                if rem is not None:
+                    headers["X-Deadline-Ms"] = str(max(int(rem), 1))
+                conn = HTTPConnection(rep.host, rep.port,
+                                      timeout=state.try_timeout)
+                conn.request("POST", self.path,
+                             json.dumps(entry.upstream_body()).encode(),
+                             headers)
+                resp = conn.getresponse()
+            except Exception:
+                _PROXY_ERRORS.labels(kind="connect").inc()
+                mem.mark_failed(rep)
+                return "retry", None
+            entry.replicas.append(rep.id)
+            if resp.status == 503:
+                data = resp.read()
+                _PROXY_ERRORS.labels(kind="status_503").inc()
+                if b"server_shutting_down" in data or b"draining" in data:
+                    rep.draining = True
+                return "retry", (data,
+                                 resp.getheader("Content-Type",
+                                                "application/json"),
+                                 resp.getheader("Retry-After"))
+            ctype = resp.getheader("Content-Type", "")
+            if "text/event-stream" not in ctype:
+                # pre-stream deterministic error (400/408...): relay with
+                # its real status — resuming a caller error elsewhere would
+                # fail identically (the replica validated the journal body)
+                try:
+                    data = resp.read()
+                except Exception:
+                    _PROXY_ERRORS.labels(kind="read").inc()
+                    mem.mark_failed(rep)
+                    return "retry", None
+                if client_started[0]:
+                    self._sse_error_event(
+                        f"replica {rep.id} refused the resume with status "
+                        f"{resp.status}", "server_error")
+                else:
+                    extra = {h: v for h in self._RELAY_HEADERS
+                             if (v := resp.getheader(h))}
+                    self._raw(resp.status, ctype or "application/json",
+                              data, extra or None)
+                return "fatal", None
+            outcome = self._durable_relay(rep, entry, resp, client_started,
+                                          key)
+            if outcome == "done":
+                _PROXY_SECONDS.observe(time.perf_counter() - t0)
+            return outcome, None
+        finally:
+            if conn is not None:
+                conn.close()
+            mem.inflight_dec(rep)
+            _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+
+    def _durable_relay(self, rep: Replica, entry, resp,
+                       client_started: list, key: bytes):
+        """Parse the upstream SSE stream event-by-event, fold token journal
+        fields into the entry, splice content past what the client already
+        has, and relay. The upstream counts content from generated-token
+        zero (a resumed replica re-emits the delivered prefix), so splicing
+        is a pure cumulative-position comparison. The affinity record for
+        `key` lands BEFORE the final client write: a client that reads the
+        completion and immediately consults routing state (tests, a
+        follow-up request on a warm connection) must observe the route."""
+        up_chars = 0
+        saw_done = False
+        events = iter_sse_data(resp)
+        while True:
+            try:
+                data = next(events)
+            except StopIteration:
+                break
+            except Exception:
+                _PROXY_ERRORS.labels(kind="read").inc()
+                self.state.membership.mark_failed(rep)
+                return "retry"
+            if data == "[DONE]":
+                saw_done = True
+                break
+            payload = parse_chunk(data)
+            if payload is None:
+                continue
+            if "error" in payload:
+                err = payload.get("error") or {}
+                if err.get("retriable"):
+                    # the replica failed AROUND the request (wedged engine,
+                    # drain, engine-scope fault) and says so: resume
+                    # elsewhere; nothing new reached the client this event
+                    _PROXY_ERRORS.labels(kind="upstream_retriable").inc()
+                    return "retry"
+                if client_started[0] or entry.stream:
+                    self._durable_start_stream(entry, resp, client_started)
+                    self._sse_error_event(
+                        err.get("message", "upstream error"),
+                        err.get("type", "server_error"))
+                else:
+                    self._error(int(err.get("code") or 500),
+                                err.get("message", "upstream error"),
+                                err.get("type", "server_error"))
+                return "fatal"
+            if "dllama" in payload:
+                entry.record_tokens(payload.pop("dllama"))
+            if entry.completion_id is None:
+                entry.completion_id = payload.get("id")
+            if entry.model is None:
+                entry.model = payload.get("model")
+            choices = payload.get("choices") or [{}]
+            delta = choices[0].get("delta") or {}
+            text = delta.get("content") or ""
+            finish = choices[0].get("finish_reason")
+            new = ""
+            if text:
+                up_chars += len(text)
+                new = entry.splice(text, up_chars)
+            if new or finish is not None:
+                if not entry.stream:
+                    if new:
+                        entry.parts.append(new)
+                    if finish is not None:
+                        entry.finish = finish
+                    continue
+                self._durable_start_stream(entry, resp, client_started)
+                payload["id"] = entry.completion_id or payload.get("id")
+                delta["content"] = new
+                if not new:
+                    delta.pop("content", None)
+                if finish is not None:
+                    entry.finish = finish
+                self._write_chunk(
+                    f"data: {json.dumps(payload)}\n\n".encode())
+        if entry.finish is None and not saw_done:
+            # the stream ended without a finish chunk or [DONE]: the replica
+            # died mid-stream (or produced a malformed empty stream) — the
+            # journal holds everything delivered; resume elsewhere
+            _PROXY_ERRORS.labels(
+                kind="empty_stream" if up_chars == 0 else "read").inc()
+            self.state.membership.mark_failed(rep)
+            return "retry"
+        self.state.affinity.record(key, rep.id)  # happens-before completion
+        if entry.stream:
+            # zero-delta completions still stream (parity with api_server)
+            self._durable_start_stream(entry, resp, client_started)
+            self._write_chunk(b"data: [DONE]\n\n")
+            self._write_chunk(b"")
+        else:
+            extra = {h: v for h in self._RELAY_HEADERS
+                     if (v := resp.getheader(h))}
+            self._json(200, {
+                "id": entry.completion_id or "chatcmpl-durable",
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": entry.model or "distributed-llama-tpu",
+                "choices": [{
+                    "index": 0,
+                    "message": {"role": "assistant",
+                                "content": "".join(entry.parts)},
+                    "finish_reason": entry.finish or "stop",
+                }],
+            }, extra or None)
+        return "done"
+
+    def _durable_start_stream(self, entry, resp, client_started: list):
+        if client_started[0]:
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        for h in self._RELAY_HEADERS:
+            v = resp.getheader(h)
+            if v:
+                self.send_header(h, v)
+        self.end_headers()
+        self._count(200)
+        client_started[0] = True
+
+    def _sse_error_event(self, message: str, etype: str) -> None:
+        """Honest mid-stream termination (client already has bytes)."""
+        self._write_chunk(
+            ("data: " + json.dumps({"error": {
+                "message": message, "type": etype}}) + "\n\n").encode())
+        self._write_chunk(b"data: [DONE]\n\n")
+        self._write_chunk(b"")
+
+    def _durable_fail(self, entry, client_started: list, code: int,
+                      message: str, etype: str) -> None:
+        self.state.journal.close(entry, "failed")
+        if client_started[0]:
+            self._sse_error_event(message, etype)
+        else:
+            self._error(code, message, etype)
+
     # ------------------------------------------------------------ proxy
 
     _RELAY_HEADERS = ("X-Request-Id", "X-Replica")
 
-    def _proxy_try(self, rep: Replica, raw: bytes, key: bytes, hop=None):
+    def _proxy_try(self, rep: Replica, raw: bytes, key: bytes, hop=None,
+                   extra_headers: dict | None = None):
         """One proxy attempt against `rep`. Returns (outcome, relayable):
         outcome "delivered" (response fully relayed), "aborted" (failed
         after client bytes — already terminated, never retry), or "retry"
@@ -522,7 +883,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         when the failure was a replica 503 worth relaying). `hop` is this
         try's trace context, stamped upstream as `traceparent`; the
         replica's X-Request-Id/X-Replica response headers are relayed so
-        the client can reach GET /v1/requests/<id> on the serving replica."""
+        the client can reach GET /v1/requests/<id> on the serving replica.
+        `extra_headers` carries per-try headers (remaining X-Deadline-Ms)."""
         state = self.state
         mem = state.membership
         mem.inflight_inc(rep)
@@ -533,6 +895,8 @@ class RouterHandler(BaseHTTPRequestHandler):
             try:
                 faults.fire("router.proxy", replica=rep.id)
                 headers = {"Content-Type": "application/json"}
+                if extra_headers:
+                    headers.update(extra_headers)
                 if hop is not None:
                     headers["traceparent"] = hop.to_traceparent()
                 conn = HTTPConnection(rep.host, rep.port,
@@ -561,13 +925,23 @@ class RouterHandler(BaseHTTPRequestHandler):
             # non-streaming (includes pre-stream errors with real status
             # codes — api_server defers SSE headers to the first delta, so a
             # 400/408 arrives here as plain JSON): relay verbatim, no retry
-            # of non-503 errors (they are deterministic caller errors).
-            data = resp.read()
+            # of non-503 errors (they are deterministic caller errors). A
+            # body-read failure is retriable — nothing reached the client,
+            # completions are idempotent until output is delivered.
+            try:
+                data = resp.read()
+            except Exception:
+                _PROXY_ERRORS.labels(kind="read").inc()
+                mem.mark_failed(rep)
+                return "retry", None
             extra = {h: v for h in self._RELAY_HEADERS
                      if (v := resp.getheader(h))}
+            if resp.status == 200:
+                # record BEFORE relaying: the client must not observe the
+                # completion while the route is still unrecorded
+                state.affinity.record(key, rep.id)
             self._raw(resp.status, ctype, data, extra or None)
             if resp.status == 200:
-                state.affinity.record(key, rep.id)
                 _PROXY_SECONDS.observe(time.perf_counter() - t0)
             return "delivered", None
         finally:
@@ -593,13 +967,9 @@ class RouterHandler(BaseHTTPRequestHandler):
                     return "retry", None
                 # mid-stream: the client already has partial output — a
                 # retry would double-deliver. Honest termination instead.
-                self._write_chunk(
-                    ("data: " + json.dumps({"error": {
-                        "message": f"upstream replica {rep.id} failed "
-                                   "mid-stream", "type": "server_error"}})
-                     + "\n\n").encode())
-                self._write_chunk(b"data: [DONE]\n\n")
-                self._write_chunk(b"")
+                self._sse_error_event(
+                    f"upstream replica {rep.id} failed mid-stream",
+                    "server_error")
                 return "aborted", None
             if not chunk:
                 break
@@ -621,8 +991,10 @@ class RouterHandler(BaseHTTPRequestHandler):
             # nothing reached the client, so another replica may try
             _PROXY_ERRORS.labels(kind="empty_stream").inc()
             return "retry", None
-        self._write_chunk(b"")  # terminate the chunked response
+        # record BEFORE the stream terminator: the client must not be able
+        # to observe completion while the route is still unrecorded
         state.affinity.record(key, rep.id)
+        self._write_chunk(b"")  # terminate the chunked response
         _PROXY_SECONDS.observe(time.perf_counter() - t0)
         return "delivered", None
 
@@ -640,19 +1012,21 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
                  poll_interval: float = 2.0, poll_timeout: float = 2.0,
                  block_bytes: int = 64, affinity_nodes: int = 8192,
                  retries: int = 2, try_timeout: float = 120.0,
-                 seed: int = 0) -> ThreadingHTTPServer:
+                 seed: int = 0, durable: bool = True) -> ThreadingHTTPServer:
     """Build + bind the router (does NOT serve_forever — caller's thread
     choice). Membership is polled once synchronously so the first request
-    already has a rotation. `server.router_state` exposes the state."""
+    already has a rotation. `server.router_state` exposes the state.
+    `durable=False` reverts completions to the PR-6 verbatim pass-through
+    (mid-stream failures surfaced, not resumed)."""
     membership = Membership(replicas, poll_interval=poll_interval,
                             poll_timeout=poll_timeout)
     state = RouterState(membership, policy=policy, block_bytes=block_bytes,
                         affinity_nodes=affinity_nodes, retries=retries,
-                        try_timeout=try_timeout, seed=seed)
+                        try_timeout=try_timeout, seed=seed, durable=durable)
     membership.start()
     handler = type("BoundRouterHandler", (RouterHandler,),
                    {"state": state, "protocol_version": "HTTP/1.1"})
-    server = ThreadingHTTPServer((host, port), handler)
+    server = QuietServer((host, port), handler)
     server.router_state = state
     install_process_metrics()  # uptime/RSS/threads/build info on /metrics
     trace.set_process_name(f"router {host}:{server.server_address[1]}")

@@ -5,7 +5,11 @@
   FaultSpec/FaultPlan machinery, DLLAMA_FAULTS env activation.
 - `errors.py` — typed errors the serving stack raises and the HTTP layer
   maps to honest status codes, plus `classify()` (the scheduler's
-  blast-radius switch: transient / request / engine).
+  blast-radius switch: transient / request / engine) and `retriable()`
+  (the durable router's mid-stream failover switch).
+- `supervisor.py` — EngineSupervisor: escalates the dispatch-age watchdog
+  from observation to action (fail in-flight retriable, re-initialize the
+  backend, flip /healthz unhealthy so the fleet resumes elsewhere).
 
 Consumers: runtime/batch_engine.py (retry + isolation), runtime/engine.py,
 runtime/device_loop.py, runtime/paged_cache.py (injection points),
@@ -15,9 +19,11 @@ and tests/test_resilience.py (chaos drivers).
 
 from . import faults
 from .errors import (DeadlineExceeded, EngineClosed, EngineDraining,
-                     EngineSaturated, FaultInjected, InvalidRequest,
-                     TransientDispatchError, classify)
+                     EngineSaturated, EngineWedged, FaultInjected,
+                     InvalidRequest, TransientDispatchError, classify,
+                     retriable)
 
 __all__ = ["faults", "DeadlineExceeded", "EngineClosed", "EngineDraining",
-           "EngineSaturated", "FaultInjected", "InvalidRequest",
-           "TransientDispatchError", "classify"]
+           "EngineSaturated", "EngineWedged", "FaultInjected",
+           "InvalidRequest", "TransientDispatchError", "classify",
+           "retriable"]

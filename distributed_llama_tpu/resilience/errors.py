@@ -25,7 +25,7 @@ from __future__ import annotations
 
 __all__ = ["EngineClosed", "EngineDraining", "EngineSaturated",
            "DeadlineExceeded", "InvalidRequest", "TransientDispatchError",
-           "FaultInjected", "classify"]
+           "EngineWedged", "FaultInjected", "classify", "retriable"]
 
 
 class EngineClosed(RuntimeError):
@@ -66,6 +66,15 @@ class TransientDispatchError(RuntimeError):
     fault_scope = "transient"
 
 
+class EngineWedged(RuntimeError):
+    """The dispatch watchdog escalated: the engine stopped making progress
+    (a device dispatch hung past the supervisor threshold) and the
+    supervisor failed this in-flight request while it attempts backend
+    re-initialization (resilience/supervisor.py). RETRIABLE by contract:
+    the request itself is innocent — a fleet router should resume it on
+    another replica (docs/FLEET.md "Resume protocol")."""
+
+
 class FaultInjected(RuntimeError):
     """Raised by the fault-injection framework at a named point. `scope`
     declares the blast radius the scheduler may assume: "request" faults are
@@ -90,3 +99,27 @@ def classify(exc: BaseException) -> str:
     if scope in ("transient", "request", "engine"):
         return scope
     return "engine"
+
+
+def retriable(exc: BaseException) -> bool:
+    """Whether a request that failed with `exc` may be re-submitted (resumed)
+    on another replica without changing client-visible semantics — the
+    durable router's mid-stream failover switch (fleet/router.py):
+
+    - deterministic caller errors (InvalidRequest / any ValueError) and
+      expired deadlines would fail identically anywhere: NOT retriable;
+    - saturation is handled by the router's own failover/Retry-After path,
+      not the resume machinery: NOT retriable here;
+    - the request-innocent failures — engine wedged/closed under it,
+      transient dispatch errors that exhausted retries, engine-scope faults,
+      and any unclassified server error — ARE retriable: the replica died
+      around the request, the request did not poison the replica.
+
+    Request-scope injected faults are the one judgment call: the fault fired
+    inside THIS request's own callbacks/prefill, so a blind resume could
+    loop forever on a deterministic trigger — treat as NOT retriable."""
+    if isinstance(exc, (DeadlineExceeded, ValueError, EngineSaturated)):
+        return False
+    if isinstance(exc, FaultInjected):
+        return exc.fault_scope == "engine"
+    return True

@@ -1,0 +1,31 @@
+"""QuietServer: a ThreadingHTTPServer that does not stack-trace routine
+peer disconnects.
+
+A streaming serving stack sees dropped sockets CONSTANTLY — clients abandon
+SSE streams, and the durable fleet router (docs/FLEET.md) deliberately
+aborts its upstream leg the moment it decides to resume a request
+elsewhere. Each one used to print a full socketserver traceback to stderr;
+at fleet scale that noise buries real errors. Anything that is not a
+routine peer-went-away still reports normally.
+
+Stdlib-only by design: both the api_server (jax-heavy) and the fleet router
+(which must never import jax) serve HTTP through this one subclass, so the
+suppressed-exception set cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import sys
+from http.server import ThreadingHTTPServer
+
+__all__ = ["QuietServer"]
+
+_ROUTINE_DISCONNECTS = (BrokenPipeError, ConnectionResetError,
+                        ConnectionAbortedError, TimeoutError)
+
+
+class QuietServer(ThreadingHTTPServer):
+    def handle_error(self, request, client_address):
+        if isinstance(sys.exc_info()[1], _ROUTINE_DISCONNECTS):
+            return
+        super().handle_error(request, client_address)

@@ -73,7 +73,8 @@ from ..models.spec import ModelSpec
 from ..obs import flight, metrics, reqctx, trace
 from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
-                                 EngineDraining, EngineSaturated, classify)
+                                 EngineDraining, EngineSaturated,
+                                 EngineWedged, classify)
 from .engine import PREFILL_CHUNKS, GenerationStats
 from .speculative import NgramIndex
 
@@ -186,6 +187,36 @@ _SPEC_ACCEPTED = metrics.counter(
 _SPEC_ACCEPT_RATE = metrics.gauge(
     "batch_spec_accept_rate",
     "Cumulative batched accepted/drafted ratio (process lifetime)")
+# Durable-request resume (docs/FLEET.md "Resume protocol"): requests
+# re-admitted mid-generation after a replica failure, and how much of their
+# prompt ⊕ delivered-tokens prefix the admission re-prefill actually skipped
+# (same-slot rewind + radix pool seed) — the resume-cost health signal.
+_RESUMED = metrics.counter(
+    "batch_resumed_requests_total",
+    "Requests admitted with a resume prefix (mid-stream failover re-submits)")
+_RESUME_TOKENS = metrics.counter(
+    "batch_resume_prefix_tokens_total",
+    "Delivered-elsewhere tokens carried by resume admissions (the suffix the "
+    "new replica must re-prefill or reuse)")
+# Hung-engine supervision (resilience/supervisor.py): the watchdog gauge
+# escalated to action — recoveries attempted and the requests they failed.
+_WEDGE_RECOVERIES = metrics.counter(
+    "engine_wedge_recoveries_total",
+    "Supervisor escalations: a wedged scheduler was abandoned and the engine "
+    "re-initialized, by outcome", labelnames=("outcome",))
+_WEDGE_FAILED = metrics.counter(
+    "engine_wedge_failed_requests_total",
+    "In-flight/queued requests failed with EngineWedged by a supervisor "
+    "recovery (retriable: a durable router resumes them elsewhere)")
+
+
+class _StaleEpoch(BaseException):
+    """Raised inside an ABANDONED scheduler thread (recover_wedged bumped the
+    engine epoch while this thread was stuck in a device call): the thread
+    must unwind without touching engine state — the slots/queue it knew were
+    replaced, so its _fail_all/_deliver paths would corrupt the NEW epoch's
+    requests. BaseException so no blanket `except Exception` net keeps the
+    zombie serving."""
 
 
 @dataclass
@@ -204,6 +235,10 @@ class BatchRequest:
 
     cancelled: bool = False
     submit_t: float = 0.0  # perf_counter at submit(), feeds batch_queue_wait
+    # durable resume (docs/FLEET.md): the last `resume_tokens` entries of
+    # `prompt` are generated-and-delivered-elsewhere tokens, not user prompt —
+    # admission counts them separately and the sampler arrives fast-forwarded
+    resume_tokens: int = 0
     # request identity (docs/OBSERVABILITY.md "Request tracing"): `rid` keys
     # the flight-recorder timeline; `ctx` is the W3C trace context captured
     # at submit() — the scheduler thread re-enters it (reqctx.use) around
@@ -389,6 +424,17 @@ class BatchEngine:
         self._draining = False  # drain mode: serve in-flight, refuse new
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
+        # scheduler epoch (resilience/supervisor.py): recover_wedged() bumps
+        # it to abandon a scheduler thread stuck in a hung device call — the
+        # stale thread observes the bump at its next epoch check and unwinds
+        # via _StaleEpoch instead of mutating the replacement state. Each
+        # scheduler thread records ITS epoch thread-locally so the checks
+        # compare against the epoch the thread was born into, not a value
+        # re-read after the bump (which would blind the check to a bump
+        # landing between loop entry and the dispatch)
+        self._epoch = 0
+        self._tls = threading.local()
+        self.wedge_recoveries = 0  # observability: supervisor escalations
         # Admission control (docs/ROBUSTNESS.md): max_queue bounds the number
         # of requests WAITING for a slot (0 = unbounded, the pre-PR-4
         # behavior); queue_ttl bounds how long a request may wait queued;
@@ -439,14 +485,20 @@ class BatchEngine:
     def submit(self, prompt: list[int], max_tokens: int, sampler,
                on_token=None, stop_check=None, *, deadline: float | None = None,
                ttl: float | None = None, rid: str | None = None,
-               ctx=None) -> BatchRequest:
+               ctx=None, resume_tokens: int = 0) -> BatchRequest:
         """Enqueue a request. `deadline` (seconds) bounds the WHOLE request
         (queue + generation; finish reason "deadline", partial output kept);
         `ttl` bounds queue wait only (overrides the engine's queue_ttl).
         `rid`/`ctx` set the request id and trace context; both default from
         the caller's bound reqctx (api_server's handler thread) or are
         originated here, so every request is traceable even when submitted
-        outside the HTTP layer. Raises EngineDraining/EngineClosed during
+        outside the HTTP layer. `resume_tokens` marks the last N entries of
+        `prompt` as mid-stream-failover resume tokens (generated and
+        delivered by a failed replica; docs/FLEET.md "Resume protocol") —
+        the caller must pass the sampler already fast-forwarded past their
+        coins; admission then re-prefills prompt ⊕ resume (mostly a radix
+        prefix-cache hit) and generation continues byte-identical to the
+        uninterrupted run. Raises EngineDraining/EngineClosed during
         shutdown and EngineSaturated when the wait queue is at max_queue."""
         if self._draining and not self._shutdown:
             raise EngineDraining(
@@ -465,6 +517,10 @@ class BatchEngine:
         req = BatchRequest(list(prompt), max_tokens, sampler, on_token, stop_check)
         if not req.prompt:
             req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
+        req.resume_tokens = min(max(int(resume_tokens), 0), len(req.prompt))
+        if req.resume_tokens:
+            _RESUMED.inc()
+            _RESUME_TOKENS.inc(req.resume_tokens)
         # request identity: adopt the caller's trace context (the HTTP
         # handler thread's contextvar) or originate one, and make the
         # context carry the request id so the faults.fire → flight hook can
@@ -488,8 +544,13 @@ class BatchEngine:
         eff_ttl = self.queue_ttl if ttl is None else ttl
         if eff_ttl and eff_ttl > 0:
             req.queue_ttl_t = req.submit_t + eff_ttl
-        self._ensure_thread()
+        # put BEFORE ensure: racing a recover_wedged(), a request already in
+        # the queue is drained and failed retriable by the recovery, and a
+        # put landing after it finds _thread=None so ensure spawns the fresh
+        # scheduler — ensure-first could observe the doomed thread as alive
+        # and then enqueue into a queue nothing serves
         self._queue.put(req)
+        self._ensure_thread()
         with self._cond:
             self._cond.notify()
         return req
@@ -538,6 +599,103 @@ class BatchEngine:
         if self._last_dispatch_t is not None and self._last_dispatch_t > ref:
             ref = self._last_dispatch_t
         return max(time.monotonic() - ref, 0.0)
+
+    def dispatch_age(self) -> float:
+        """Public watchdog reading (resilience/supervisor.py): seconds since
+        the scheduler last made progress while work is in flight, 0 idle —
+        the same number the batch_dispatch_age_seconds gauge exports."""
+        return self._dispatch_age()
+
+    def recover_wedged(self, error: Exception | None = None,
+                       reinit: bool = True) -> bool:
+        """Supervisor escalation (resilience/supervisor.py, docs/ROBUSTNESS.md):
+        the scheduler stopped making progress — a device dispatch (or its
+        result transfer) is hung, the BENCH_r03/r04 documented backend outage
+        shape — so act instead of observing:
+
+        1. ABANDON the wedged scheduler thread: bump the engine epoch. The
+           stuck thread cannot be interrupted, but every path it can wake on
+           checks the epoch before touching engine state and unwinds via
+           _StaleEpoch; its locals reference the OLD slot objects and OLD
+           cache arrays, both replaced below.
+        2. FAIL every in-flight and queued request with EngineWedged — a
+           RETRIABLE error: the HTTP layer surfaces it as a resumable
+           failure, so a durable fleet router re-submits each request's
+           journal to a surviving replica (docs/FLEET.md "Resume protocol").
+        3. RE-INITIALIZE the backend (`reinit=True`): drop every compiled
+           loop/step and allocate fresh KV caches, so the next admission
+           runs against clean device state instead of buffers a zombie
+           dispatch may still write. Returns False when re-init itself
+           fails (the replica should stay unhealthy and be ejected).
+
+        The next submit() lazily starts a fresh scheduler thread. Safe to
+        call from any thread; concurrent calls serialize on the engine lock.
+        """
+        err = error if error is not None else EngineWedged(
+            f"engine made no dispatch progress for "
+            f"{self._dispatch_age():.1f}s; in-flight requests failed "
+            "(retriable) and the backend was re-initialized")
+        with self._lock:
+            self._epoch += 1
+            stale = self._thread
+            self._thread = None  # next submit spawns a fresh scheduler
+        if stale is not None and stale.is_alive():
+            # a LIVE (merely slow, or killed-by-a-test) scheduler observes
+            # the bump at its next loop/dispatch check and exits within one
+            # iteration — wait briefly so the slot/cache swap below runs
+            # single-threaded. A genuinely hung thread times this out and
+            # is caught by the thread-epoch checks when it eventually wakes.
+            stale.join(timeout=1.0)
+        self.wedge_recoveries += 1
+        old_slots = self._slots
+        with self._plock:
+            # fresh slot objects FIRST: the abandoned thread's locals hold
+            # refs to the old list, so nothing it does can reach new requests
+            self._slots = [_Slot(i) for i in range(self.slots_n)]
+            for s in old_slots:
+                if self.prefix_cache is not None and s.lease is not None:
+                    self.prefix_cache.release(s.lease)
+                    s.lease = None
+                req = s.req
+                s.req = None
+                s.pending = []
+                if req is not None and not req.done.is_set():
+                    req.error = err
+                    req.finish = "error"
+                    _WEDGE_FAILED.inc()
+                    flight.finish(req.rid, "error", error=repr(err))
+                    req.done.set()
+            while True:
+                try:
+                    self._pending.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            for req in self._pending:
+                req.error = err
+                req.finish = "error"
+                _WEDGE_FAILED.inc()
+                flight.finish(req.rid, "error", error=repr(err))
+                req.done.set()
+            self._pending.clear()
+            _QUEUE_DEPTH.set(0)
+            _SLOTS_OCCUPIED.set(0)
+        self._inflight = None
+        _PIPELINE_DEPTH.set(0)
+        self._last_dispatch_t = None  # age restarts from the next admission
+        ok = True
+        if reinit:
+            try:
+                faults.fire("engine.reinit")
+                eng = self._eng
+                self._loops.clear()
+                eng._steps.clear()
+                eng._decode_loops.clear()
+                eng.k_cache, eng.v_cache = eng._init_cache()
+            except Exception as e:
+                ok = False
+                print(f"🔴 backend re-initialization failed: {e!r}")
+        _WEDGE_RECOVERIES.labels(outcome="ok" if ok else "reinit_failed").inc()
+        return ok
 
     def close(self, drain: bool = False, timeout: float | None = None) -> None:
         """Stop the engine. `drain=True` (the SIGTERM path): refuse new
@@ -646,13 +804,20 @@ class BatchEngine:
         # prompt-lookup draws drafts from exactly that repetitive history
         best.ngram = NgramIndex(req.prompt) if self.spec_k else None
         req.stats.prompt_tokens = len(req.prompt)
+        # admission reuse reading (rewind + radix seed): the prefill this
+        # request SKIPPED — for a resume admission this is the number the
+        # "resume cost ≈ one suffix prefill" claim rests on, surfaced per
+        # request so api-level resume counters can report it
+        req.stats.reused_tokens = reuse
         qw_ms = ((time.perf_counter() - req.submit_t) * 1e3
                  if req.submit_t else 0.0)
         if req.submit_t:
             _QUEUE_WAIT.observe(qw_ms / 1e3)
         flight.event(req.rid, "admitted", slot=best.index,
                      queue_wait_ms=round(qw_ms, 3), rewind_tokens=rewind,
-                     seeded_tokens=reuse - rewind)
+                     seeded_tokens=reuse - rewind,
+                     **({"resume_tokens": req.resume_tokens}
+                        if req.resume_tokens else {}))
         return best
 
     def _seed_from_cache(self, slot: _Slot, req: BatchRequest,
@@ -716,16 +881,30 @@ class BatchEngine:
         Retry is sound here because a transient failure by definition raised
         before the dispatch consumed its inputs (the injection points fire
         before the device call; a real mid-execution failure classifies
-        'engine' and is never retried against possibly-donated buffers)."""
+        'engine' and is never retried against possibly-donated buffers).
+
+        EPOCH GUARD (recover_wedged): when the supervisor abandoned this
+        thread while it was stuck inside `call()` (or the injected-latency
+        sleep standing in for a hung device), the bump is observed HERE, on
+        the first instruction after the stall — before the caller can rebind
+        eng.k_cache/v_cache over the re-initialized backend's fresh arrays
+        or deliver tokens into slots that now belong to other requests."""
         delay = self.retry_backoff
         attempt = 0
+        # the THREAD's epoch, not a fresh read: a bump landing before this
+        # call must still be detected at the post-call check
+        epoch = getattr(self._tls, "epoch", self._epoch)
         while True:
             try:
                 faults.fire("batch.dispatch", kind=kind, attempt=attempt)
                 out = call()
+                if self._epoch != epoch:
+                    raise _StaleEpoch()
                 self._last_dispatch_t = time.monotonic()
                 return out
             except Exception as e:
+                if self._epoch != epoch:
+                    raise _StaleEpoch() from None
                 if classify(e) != "transient" or attempt >= self.max_retries:
                     raise
                 _ENGINE_ERRORS.labels(kind="transient").inc()
@@ -749,13 +928,18 @@ class BatchEngine:
         step = eng._step_for(window)
         toks = jnp.asarray(np.asarray(tokens_rows, dtype=np.int32))
         start_pos = jnp.asarray(np.asarray(starts, dtype=np.int32))
+        # snapshot the cache refs NOW and rebind only after _dispatched's
+        # epoch check: a thread abandoned by recover_wedged mid-stall must
+        # neither donate the re-initialized backend's fresh cache arrays nor
+        # rebind its stale outputs over them
+        kc_in, vc_in = eng.k_cache, eng.v_cache
 
         def call():
-            logits, eng.k_cache, eng.v_cache = step(
-                eng.params, eng.rope, toks, eng.k_cache, eng.v_cache, start_pos)
-            return np.asarray(logits)
+            logits, kc, vc = step(
+                eng.params, eng.rope, toks, kc_in, vc_in, start_pos)
+            return np.asarray(logits), kc, vc
 
-        out = self._dispatched(kind, call)
+        out, eng.k_cache, eng.v_cache = self._dispatched(kind, call)
         # sync dispatch: results are host-side now — the reference point the
         # device-idle-gap histogram measures the next decode issue against
         self._gap_t = time.perf_counter()
@@ -947,17 +1131,23 @@ class BatchEngine:
                 self._finish(s, "error")
 
     def _loop(self) -> None:
+        epoch = self._epoch
+        self._tls.epoch = epoch  # the epoch this thread was born into
         _SCHED_ALIVE.set(1)
         try:
-            while not self._shutdown:
+            while not self._shutdown and self._epoch == epoch:
                 try:
                     self._loop_once()
+                except _StaleEpoch:
+                    return  # abandoned by recover_wedged: unwind silently
                 except Exception as e:
                     # _loop_once guards the dispatch phase itself; this outer
                     # net covers the admission/reap phase too (prefix-cache
                     # lookup at _assign, lease release at a deadline _finish)
                     # so NO exception can kill the scheduler thread — the
                     # invariant perf/fault_matrix.py asserts
+                    if self._epoch != epoch:
+                        return  # stale thread: the state is not ours to fail
                     try:
                         self._fail_all(e)
                     except Exception:
@@ -966,11 +1156,14 @@ class BatchEngine:
                         if not self._shutdown:
                             self._cond.wait(timeout=0.05)
         finally:
-            if self._inflight is not None:  # close() mid-pipeline
-                _PIPELINE_FLUSHES.labels(reason="close").inc()
-                self._inflight = None
-            _PIPELINE_DEPTH.set(0)
-            _SCHED_ALIVE.set(0)
+            # a stale thread's exit must not clobber the replacement epoch's
+            # liveness gauge or pipeline state
+            if self._epoch == epoch:
+                if self._inflight is not None:  # close() mid-pipeline
+                    _PIPELINE_FLUSHES.labels(reason="close").inc()
+                    self._inflight = None
+                _PIPELINE_DEPTH.set(0)
+                _SCHED_ALIVE.set(0)
 
     def _loop_once(self) -> None:
         self._admit()
@@ -1356,16 +1549,18 @@ class BatchEngine:
         if self._gap_t is not None:
             _DISPATCH_GAP.observe(max(time.perf_counter() - self._gap_t, 0.0))
         t_issue = time.perf_counter()
+        kc_in, vc_in = eng.k_cache, eng.v_cache  # same stale-epoch discipline
         with trace.span("batch.verify_issue",
                         {"block": t, "rows": len(rows),
                          "drafted": sum(max(n, 0) for n in ndraft)}):
             def call():
-                toks, acc, tok, pos, rng_out, eng.k_cache, eng.v_cache = loop(
-                    eng.params, eng.rope, props, eng.k_cache, eng.v_cache,
+                toks, acc, tok, pos, rng_out, kc, vc = loop(
+                    eng.params, eng.rope, props, kc_in, vc_in,
                     starts, rng, temps, topps, ndraft)
-                return toks, acc, tok, pos, rng_out
+                return toks, acc, tok, pos, rng_out, kc, vc
 
-            toks, acc, tok, pos, rng_out = self._dispatched("verify", call)
+            (toks, acc, tok, pos, rng_out, eng.k_cache,
+             eng.v_cache) = self._dispatched("verify", call)
         _PIPELINE_DEPTH.set(1)
         for a in (toks, acc, rng_out):
             try:
@@ -1548,16 +1743,18 @@ class BatchEngine:
             tok_in, pos_in, rng_in = chain.tok, chain.pos, chain.rng
             _DISPATCH_GAP.observe(0.0)  # chained: the device never went idle
         t_issue = time.perf_counter()
+        kc_in, vc_in = eng.k_cache, eng.v_cache  # same stale-epoch discipline
         with trace.span("batch.super_step_issue",
                         {"k": k, "rows": len(rows),
                          "chained": chain is not None}):
             def call():
-                toks, tok, pos, rng_out, eng.k_cache, eng.v_cache = loop(
-                    eng.params, eng.rope, tok_in, eng.k_cache, eng.v_cache,
+                toks, tok, pos, rng_out, kc, vc = loop(
+                    eng.params, eng.rope, tok_in, kc_in, vc_in,
                     pos_in, rng_in, temps, topps, budget)
-                return toks, tok, pos, rng_out
+                return toks, tok, pos, rng_out, kc, vc
 
-            toks, tok, pos, rng_out = self._dispatched("super_step", call)
+            (toks, tok, pos, rng_out, eng.k_cache,
+             eng.v_cache) = self._dispatched("super_step", call)
         _PIPELINE_DEPTH.set(2 if chain is not None else 1)
         for a in (toks, rng_out):
             try:  # start the non-blocking host copy now; delivery's
@@ -1577,6 +1774,7 @@ class BatchEngine:
         validity oracle for a dispatch chained from this one's carry."""
         k = fl.k
         s = self.spec.seq_len
+        epoch = getattr(self._tls, "epoch", self._epoch)
         with trace.span("batch.super_step", {"k": k, "rows": len(fl.rows),
                                              "tokens": sum(fl.budget),
                                              "kind": fl.kind,
@@ -1584,6 +1782,11 @@ class BatchEngine:
             toks = np.asarray(fl.toks)  # (k, B): blocks until the device lands
             rng_out = np.asarray(fl.rng)
             acc = np.asarray(fl.acc) if fl.kind == "verify" else None
+        if self._epoch != epoch:
+            # a hung transfer is the other place a wedged thread blocks; an
+            # abandoned thread waking here must not deliver into slots that
+            # now belong to the replacement epoch's requests
+            raise _StaleEpoch()
         t_ready = time.perf_counter()
         self._last_dispatch_t = time.monotonic()
         # device-span estimate: the device could not start this dispatch

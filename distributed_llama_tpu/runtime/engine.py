@@ -59,6 +59,10 @@ class GenerationStats:
 
     prompt_tokens: int = 0
     generated_tokens: int = 0
+    # prompt tokens whose prefill was skipped at admission (same-slot rewind
+    # + radix prefix-cache seed) — for a resumed request this is the share of
+    # prompt ⊕ delivered-tokens the new replica did NOT have to re-run
+    reused_tokens: int = 0
     prefill_ms: float = 0.0
     # Per-token wall/device times. NOTE: when a dispatch covers several tokens
     # (speculative verify blocks, device-loop chunks, BatchEngine super-steps)

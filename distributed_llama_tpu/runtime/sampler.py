@@ -47,6 +47,20 @@ class Sampler:
         self.state, u = _random_u32(self.state)
         return (u >> 8) / 16777216.0  # randomF32, utils.cpp:88-90
 
+    def fast_forward(self, n_tokens: int) -> None:
+        """Advance the xorshift* stream past the coins `n_tokens` already
+        sampled tokens consumed — the RNG half of a durable-request resume
+        (docs/FLEET.md "Resume protocol"): a replica re-admitting a request
+        whose first k generated tokens were delivered elsewhere prefills
+        prompt ⊕ those tokens and fast-forwards the sampler by k, so its
+        continuation is byte-identical to the uninterrupted run. Every
+        stochastic sample() draws EXACTLY one coin (mult and top-p alike);
+        greedy (temperature 0) draws none, so this is a no-op there."""
+        if self.temperature == 0.0:
+            return
+        for _ in range(n_tokens):
+            self.state, _ = _random_u32(self.state)
+
     def sample(self, logits: np.ndarray) -> int:
         logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
         if self.temperature == 0.0:

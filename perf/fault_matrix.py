@@ -59,6 +59,18 @@ ROUTER_POINTS = ("router.proxy", "router.health")
 # api.request is HTTP-layer; its shed/validation/drain behavior is asserted
 # against a live server in tests/test_resilience.py, not here.
 
+# Durability family (ISSUE 9, docs/FLEET.md "Resume protocol"): mid-stream
+# replica kill (a wedged engine failing all in-flight — the supervisor
+# escalation shape) through the REAL durable router over two REAL in-process
+# replicas, crossed over {stream, non-stream} × {pipelined, speculative}
+# engines × {resume on, off}. Resume-on cells assert ZERO client-visible
+# failures and byte-identical output vs a fault-free reference; resume-off
+# cells assert the failure semantics the PR-6 router promised (mid-stream
+# SSE error surfaced honestly for streams; pre-output failures retried).
+DURABILITY_ENGINES = ("pipelined", "speculative")
+DURABILITY_CELLS = len(DURABILITY_ENGINES) * 2 * 2  # × stream × resume
+SUPERVISOR_CELLS = 1  # fault-injected hang -> supervisor recovery
+
 
 def _spec(seq_len=128):
     return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
@@ -353,6 +365,309 @@ def run_router_cell(router, point: str, kind: str) -> list[str]:
     return problems
 
 
+def run_supervisor_cell() -> list[str]:
+    """Hung-engine supervision (resilience/supervisor.py): a deterministic
+    fault-injected hang (latency fault parking the scheduler in a 600 s
+    sleep at batch.dispatch — the BENCH_r03/r04 backend-outage stand-in)
+    must be recovered within the supervisor's escalation threshold: the
+    in-flight request fails with the RETRIABLE EngineWedged, the backend
+    re-initializes, and a fault-free probe completes on the fresh scheduler
+    while the zombie thread is still asleep."""
+    from distributed_llama_tpu.resilience.errors import EngineWedged
+    from distributed_llama_tpu.resilience.supervisor import EngineSupervisor
+
+    problems: list[str] = []
+    spec, be = build_batch_engine(pipeline=True)
+    sup = EngineSupervisor(be, threshold=1.0, poll=0.1)
+    try:
+        # warm the shapes so the hang is the only slow thing in the cell
+        be.generate([1, 7, 23, 5], 4, _greedy(spec))
+        with faults.active(FaultSpec("batch.dispatch", kind="latency",
+                                     delay_ms=600_000, count=1)):
+            req = be.submit([1, 9, 9, 2], 8, _greedy(spec))
+            t0 = time.monotonic()
+            while be.dispatch_age() <= 1.0 and time.monotonic() - t0 < 30:
+                time.sleep(0.02)
+            t_esc = time.monotonic()
+            sup.check_once()
+            try:
+                req.wait(timeout=10)
+                problems.append("supervisor: wedged request COMPLETED "
+                                "(hang never engaged?)")
+            except EngineWedged:
+                pass  # the retriable failure the escalation promises
+            except Exception as e:
+                problems.append(f"supervisor: wedged request failed with "
+                                f"{e!r}, want EngineWedged")
+            if time.monotonic() - t_esc > 5.0:
+                problems.append("supervisor: escalation took "
+                                f"{time.monotonic() - t_esc:.1f}s")
+        faults.uninstall()
+        if not sup.healthy:
+            problems.append(f"supervisor: state {sup.state} after recovery")
+        if sup.recoveries != 1:
+            problems.append(f"supervisor: {sup.recoveries} recoveries, want 1")
+        try:
+            probe = be.submit([1, 2, 3], 4, _greedy(spec))
+            out = probe.wait(timeout=120)
+            if len(out) != 4:
+                problems.append(f"supervisor: probe generated {len(out)}/4 "
+                                "after recovery")
+        except Exception as e:
+            problems.append(f"supervisor: probe failed after recovery: {e!r}")
+    finally:
+        faults.uninstall()
+        sup.stop()
+        be.close()
+    return problems
+
+
+# ----------------------------------------------------------------------
+# durability family: real replicas, real router, mid-stream kill
+# ----------------------------------------------------------------------
+
+_FLEET_MODEL: tuple | None = None
+
+
+def _fleet_model_files():
+    """Tiny real checkpoint + byte-fallback tokenizer, written once per run
+    (the durability family needs full api_server replicas, which load from
+    files)."""
+    global _FLEET_MODEL
+    if _FLEET_MODEL is not None:
+        return _FLEET_MODEL
+    import tempfile
+
+    from distributed_llama_tpu.formats.mfile import (params_file_order,
+                                                     write_model)
+    from distributed_llama_tpu.formats.tfile import (TokenizerData,
+                                                     write_tokenizer)
+
+    tmp = tempfile.mkdtemp(prefix="dlt_durability_")
+    spec = _spec(seq_len=192)
+    params = init_random_params(spec, FloatType.F32, seed=21)
+    mpath = os.path.join(tmp, "m.m")
+    write_model(mpath, spec, params_file_order(spec, params), FloatType.F32)
+    vocab = ([b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(251)]
+             + [b"<|im_start|>", b"<|im_end|>"])
+    scores = [0.0] * 254 + [-1.0, -1.0]
+    tpath = os.path.join(tmp, "t.t")
+    write_tokenizer(tpath, TokenizerData(
+        vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=254,
+        max_token_length=12, chat_template="{{<|im_start|>}}"))
+    _FLEET_MODEL = (mpath, tpath)
+    return _FLEET_MODEL
+
+
+def build_durable_fleet(speculative: int = 0):
+    """Two REAL in-process api_server replicas (tiny checkpoint, batched
+    engines) fronted by the REAL durable router. Returns
+    (replicas=[(engine, server, port)], router, rport, close)."""
+    import threading
+
+    from distributed_llama_tpu.apps.api_server import serve
+    from distributed_llama_tpu.fleet.router import close_router, serve_router
+    from distributed_llama_tpu.formats.mfile import load_model
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.tokenizer import TemplateType
+    from distributed_llama_tpu.tokenizer.bpe import Tokenizer
+
+    mpath, tpath = _fleet_model_files()
+    reps = []
+    for _ in range(2):
+        lspec, lparams = load_model(mpath, 0)
+        be = BatchEngine(lspec, lparams, Tokenizer.load(tpath), slots=2,
+                         tp=1, superstep=4, speculative=speculative)
+        srv = serve(None, host="127.0.0.1", port=0,
+                    template_type=TemplateType.CHATML, batch_engine=be)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        reps.append((be, srv, srv.server_address[1]))
+    router = serve_router([f"127.0.0.1:{p}" for _, _, p in reps],
+                          host="127.0.0.1", port=0, poll_interval=0.15,
+                          block_bytes=16, retries=2, try_timeout=60.0)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+
+    def close():
+        close_router(router)
+        for be, srv, _p in reps:
+            srv.shutdown()
+            srv.server_close()
+            be.close()
+
+    return reps, router, router.server_address[1], close
+
+
+def _durability_request(rport: int, stream: bool) -> dict:
+    """One completion through the router; returns {text, error, status}.
+    The repetitive content makes n-gram drafts engage on spec engines."""
+    import http.client
+    import json as _json
+
+    body = {"messages": [
+        {"role": "system", "content": "shared fleet system prompt abcb abcb"},
+        {"role": "user", "content": "ab ab ab ab ab ab ab ab"}],
+        "max_tokens": 48, "temperature": 0.8, "seed": 4242, "stream": stream}
+    conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+    try:
+        conn.request("POST", "/v1/chat/completions", _json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if not stream:
+            data = _json.loads(resp.read() or b"{}")
+            if resp.status != 200:
+                return {"text": None, "error": data, "status": resp.status}
+            return {"text": data["choices"][0]["message"]["content"],
+                    "error": None, "status": 200}
+        if resp.status != 200:
+            return {"text": None, "error": resp.read().decode(),
+                    "status": resp.status}
+        text, err = [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            payload = _json.loads(line[6:])
+            if "error" in payload:
+                err = payload["error"]
+                break
+            d = payload["choices"][0]["delta"].get("content")
+            if d:
+                text.append(d)
+        return {"text": "".join(text), "error": err, "status": 200}
+    except Exception as e:
+        return {"text": None, "error": repr(e), "status": None}
+    finally:
+        conn.close()
+
+
+def _start_killer(reps, min_tokens: int = 3):
+    """Background thread that wedges (recover_wedged: fail in-flight
+    retriable, re-init backend — the supervisor escalation body) whichever
+    replica is observed serving a request with >= min_tokens generated.
+    Returns (thread, fired: list)."""
+    import threading
+
+    fired: list[str] = []
+
+    def run():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not fired:
+            for be, _srv, port in reps:
+                with be._plock:
+                    busy = any(s.req is not None
+                               and len(s.req.out) >= min_tokens
+                               for s in be._slots)
+                if busy:
+                    fired.append(str(port))
+                    be.recover_wedged()
+                    return
+            time.sleep(0.002)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, fired
+
+
+def run_durability_cell(reps, router, rport: int, stream: bool,
+                        resume_on: bool, ref_text: str,
+                        tag: str) -> list[str]:
+    """One mid-stream-kill cell. Resume ON: zero client-visible failures and
+    byte-identical output. Resume OFF (the PR-6 router semantics): a stream
+    that lost its replica mid-flight surfaces an honest SSE error; a
+    non-stream request either completes identically via the pre-output
+    retry path or surfaces an honest error status — never a hang, and the
+    router/poller must survive either way."""
+    from distributed_llama_tpu.obs import metrics as obs_metrics
+
+    problems: list[str] = []
+    name = (f"{tag}/{'stream' if stream else 'nonstream'}/"
+            f"resume={'on' if resume_on else 'off'}")
+    state = router.router_state
+    state.durable = resume_on
+    resumed0 = (obs_metrics.snapshot()
+                .get("router_resumed_requests_total") or 0)
+    killer, fired = _start_killer(reps)
+    try:
+        res = _durability_request(rport, stream)
+    finally:
+        killer.join(timeout=60)
+        state.durable = True
+    if not fired:
+        problems.append(f"{name}: the kill never engaged (request finished "
+                        "before any replica had 3 tokens in flight)")
+        return problems
+    if resume_on:
+        if res["error"] is not None or res["status"] != 200:
+            problems.append(f"{name}: client-visible failure {res!r}")
+        elif res["text"] != ref_text:
+            problems.append(f"{name}: output diverged from fault-free "
+                            f"reference ({res['text'][:40]!r} vs "
+                            f"{ref_text[:40]!r})")
+        resumed = (obs_metrics.snapshot()
+                   .get("router_resumed_requests_total") or 0)
+        if stream and resumed <= resumed0:
+            problems.append(f"{name}: no resume recorded — the cell was "
+                            "vacuous")
+    else:
+        if stream:
+            # honest surfacing: the client must see the SSE error event
+            # (never a silent truncation or a double-delivered splice)
+            if res["error"] is None and res["text"] != ref_text:
+                problems.append(f"{name}: stream neither errored nor "
+                                f"matched the reference: {res!r}")
+        elif res["status"] not in (200, 500, 502, 503):
+            problems.append(f"{name}: unexpected status {res!r}")
+        elif res["status"] == 200 and res["text"] != ref_text:
+            # pre-output retry completed it: identity holds (pinned seed
+            # comes from the request body here)
+            problems.append(f"{name}: retried non-stream diverged: {res!r}")
+    # fleet must recover for the next cell: wedged engine serves again
+    # (recover_wedged re-initialized it) once the poller sees it healthy
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        state.membership.poll_once()
+        if len(state.membership.in_rotation()) == len(reps):
+            break
+        time.sleep(0.05)
+    else:
+        problems.append(f"{name}: rotation did not recover after the kill")
+    return problems
+
+
+def run_durability_family() -> tuple[int, list[str]]:
+    cells = 0
+    problems: list[str] = []
+    for tag in DURABILITY_ENGINES:
+        spec_k = 4 if tag == "speculative" else 0
+        reps, router, rport, close = build_durable_fleet(speculative=spec_k)
+        try:
+            refs = {}
+            for stream in (True, False):
+                ref = _durability_request(rport, stream)
+                if ref["error"] is not None:
+                    problems.append(f"{tag}: fault-free reference failed: "
+                                    f"{ref!r}")
+                    cells += 4
+                    break
+                refs[stream] = ref["text"]
+            else:
+                if refs[True] != refs[False]:
+                    problems.append(f"{tag}: stream vs non-stream reference "
+                                    "mismatch")
+                for stream in (True, False):
+                    for resume_on in (True, False):
+                        cells += 1
+                        problems += run_durability_cell(
+                            reps, router, rport, stream, resume_on,
+                            refs[stream], tag)
+        finally:
+            close()
+    return cells, problems
+
+
 def run_matrix(include_paged: bool = True,
                kinds=KINDS) -> tuple[int, list[str]]:
     cells = 0
@@ -413,6 +728,12 @@ def run_matrix(include_paged: bool = True,
         for s in stubs:
             s.shutdown()
             s.server_close()
+    # hung-engine supervision + durable mid-stream failover (ISSUE 9)
+    cells += SUPERVISOR_CELLS
+    problems += run_supervisor_cell()
+    d_cells, d_problems = run_durability_family()
+    cells += d_cells
+    problems += d_problems
     return cells, problems
 
 

@@ -1,0 +1,143 @@
+"""Durable-resume unit tests (ISSUE 9, no server): the two properties the
+mid-stream failover protocol rests on.
+
+1. Sampler RNG fast-forward (runtime/sampler.py): every stochastic sample()
+   draws exactly one xorshift* coin and greedy draws none, so a fresh
+   sampler fast-forwarded by k continues the uninterrupted coin stream
+   byte-identically — property-tested over random seeds, stop positions k,
+   and greedy/stochastic parameter mixes.
+2. Engine-level resume (runtime/batch_engine.py): submitting
+   prompt ⊕ out[:k] with the remaining budget and a fast-forwarded sampler
+   regenerates out[k:] exactly — the forced-prefix admission the api
+   server's `resume` payload rides, including mixed greedy/stochastic rows
+   co-batched with ordinary requests.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+from distributed_llama_tpu.runtime.sampler import Sampler
+
+VOCAB = 97
+
+
+def _logits_at(step: int, salt: int) -> np.ndarray:
+    """Deterministic per-step logits — a stand-in model whose 'generation'
+    depends only on the step index, so resume-at-k needs no KV state."""
+    rng = np.random.default_rng(step * 1000003 + salt)
+    return rng.normal(0.0, 3.0, VOCAB).astype(np.float32)
+
+
+@pytest.mark.parametrize("temperature,topp", [
+    (0.0, 0.9),     # greedy: zero coins consumed
+    (0.8, 0.9),     # nucleus: one coin per token
+    (1.2, 1.0),     # plain multinomial (topp disabled): one coin per token
+    (0.3, 0.05),    # tiny nucleus: still exactly one coin per token
+])
+def test_fast_forward_resume_matches_uninterrupted(temperature, topp):
+    rnd = random.Random(hash((temperature, topp)) & 0xFFFF)
+    for trial in range(20):
+        seed = rnd.randrange(1, 2**31)
+        n = rnd.randrange(4, 40)
+        k = rnd.randrange(0, n + 1)
+        salt = rnd.randrange(1000)
+        full = Sampler(VOCAB, temperature, topp, seed)
+        ref = [full.sample(_logits_at(i, salt)) for i in range(n)]
+        resumed = Sampler(VOCAB, temperature, topp, seed)
+        resumed.fast_forward(k)
+        cont = [resumed.sample(_logits_at(i, salt)) for i in range(k, n)]
+        assert cont == ref[k:], (trial, seed, n, k)
+        # the states converge too: a later resume-of-the-resume stays exact
+        assert resumed.state == full.state
+
+
+def test_fast_forward_greedy_is_noop():
+    s = Sampler(VOCAB, 0.0, 0.9, 1234)
+    s.fast_forward(50)
+    assert s.state == np.uint64(1234)
+
+
+def test_fast_forward_equals_consumed_coins():
+    """fast_forward(k) lands on exactly the state after k sample() calls —
+    the invariant that makes the resume count 'delivered tokens', not some
+    sampler-internal number."""
+    for seed in (1, 7, 0xDEADBEEF):
+        s = Sampler(VOCAB, 0.9, 0.9, seed)
+        for i in range(13):
+            s.sample(_logits_at(i, 0))
+        ff = Sampler(VOCAB, 0.9, 0.9, seed)
+        ff.fast_forward(13)
+        assert ff.state == s.state
+
+
+# ----------------------------------------------------------------------
+# engine-level forced-prefix resume
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=160, rope_type=RopeType.LLAMA).resolved()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4)
+    yield spec, be
+    be.close()
+
+
+PROMPT = [1, 7, 23, 5, 40, 9]
+GEN = 20
+
+
+def _run(be, spec, prompt, gen, temperature, seed, ff=0, resume_tokens=0):
+    s = Sampler(spec.vocab_size, temperature, 0.9, seed)
+    s.fast_forward(ff)
+    req = be.submit(list(prompt), gen, s, resume_tokens=resume_tokens)
+    return req.wait(timeout=300), req
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_engine_resume_at_k_byte_identical(engine, temperature):
+    spec, be = engine
+    ref, _ = _run(be, spec, PROMPT, GEN, temperature, seed=99)
+    assert len(ref) == GEN
+    for k in (1, 7, GEN - 1):
+        cont, req = _run(be, spec, PROMPT + ref[:k], GEN - k, temperature,
+                         seed=99, ff=k, resume_tokens=k)
+        assert cont == ref[k:], (temperature, k)
+        # the admission counted the resume and reported its reuse reading
+        assert req.resume_tokens == k
+        assert req.stats.reused_tokens >= 0
+
+
+def test_engine_resume_mixed_rows_concurrent(engine):
+    """A resumed stochastic request co-batched with a fresh greedy one:
+    both finish token-identical to their solo references (the resume's
+    fast-forwarded RNG must survive super-step batching + rollback)."""
+    spec, be = engine
+    ref_s, _ = _run(be, spec, PROMPT, GEN, 0.8, seed=7)
+    ref_g, _ = _run(be, spec, [1, 3, 3, 8], GEN, 0.0, seed=0)
+    k = 6
+    s1 = Sampler(spec.vocab_size, 0.8, 0.9, 7)
+    s1.fast_forward(k)
+    r1 = be.submit(PROMPT + ref_s[:k], GEN - k, s1, resume_tokens=k)
+    r2 = be.submit([1, 3, 3, 8], GEN, Sampler(spec.vocab_size, 0.0, 0.9, 0))
+    assert r1.wait(timeout=300) == ref_s[k:]
+    assert r2.wait(timeout=300) == ref_g
+
+
+def test_engine_resume_budget_exhausted(engine):
+    """Resuming at k == total budget generates nothing and finishes
+     'length' — the resumed run stops exactly where the original would."""
+    spec, be = engine
+    ref, _ = _run(be, spec, PROMPT, GEN, 0.8, seed=42)
+    cont, req = _run(be, spec, PROMPT + ref, 0, 0.8, seed=42, ff=GEN,
+                     resume_tokens=GEN)
+    assert cont == []
+    assert req.finish == "length"

@@ -45,6 +45,21 @@ def test_scan_covers_fleet_package():
     assert os.path.join("distributed_llama_tpu", "apps", "router.py") in rel
 
 
+def test_scan_covers_resilience_package():
+    """The resilience layer (ISSUE 9 satellite, mirroring the fleet/ and
+    cache/ coverage tests): faults, errors, the hung-engine supervisor and
+    the durable-fleet journal must all ride the compile + dead-import
+    gate."""
+    files = smoke_lint.repo_py_files()
+    rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
+    for mod in ("faults", "errors", "supervisor", "__init__"):
+        assert os.path.join("distributed_llama_tpu", "resilience",
+                            f"{mod}.py") in rel, mod
+    assert os.path.join("distributed_llama_tpu", "fleet",
+                        "journal.py") in rel
+    assert os.path.join("perf", "fault_matrix.py") in rel
+
+
 def test_metric_names_documented():
     """ISSUE 7 satellite: every metrics.counter/gauge/histogram name
     registered anywhere in the package must appear in
