@@ -1,0 +1,20 @@
+"""Repo-native static analysis (docs/ANALYSIS.md).
+
+Dependency-free AST passes over the repo's own concurrency and hot-path
+conventions, plus a runtime compile-manifest auditor, unified behind one
+runner (perf/dlint.py, tier-1 via tests/test_dlint.py):
+
+    from distributed_llama_tpu.analysis import runner
+    report = runner.run()            # static passes
+    report = runner.run(compile_gate=True)   # + tiny-model compile audit
+
+The package imports NOTHING heavy at module scope — the static passes are
+pure stdlib (ast/compileall/re), so dlint runs in any environment the repo
+checks out in; only the compile-manifest gate touches jax, and only when
+asked.
+"""
+
+from . import core  # noqa: F401  (re-export surface: Finding et al.)
+from .core import Finding, Source, repo_py_files  # noqa: F401
+
+__all__ = ["core", "Finding", "Source", "repo_py_files"]

@@ -1,0 +1,292 @@
+"""Compile-manifest gate (rule `compile-manifest`): recompile-creep auditor.
+
+A TPU serving process must settle into a FIXED set of compiled programs —
+the forward step per window bucket, the K-step scan per (k, mode), the
+verify block per (T, mode) — each dispatched at a fixed set of array
+shapes/dtypes. Recompile creep (a new T bucket minted on the latency path, a
+dtype drifting through a refactor, a shape leaking per-request) is invisible
+to unit tests and BENCH_r03/r04-class expensive on hardware: XLA compiles
+mid-traffic and the request eating the compile times out.
+
+This auditor is runtime-assisted: `CompileAudit` patches the program
+factories (`make_sharded_forward`, `make_decode_loop`,
+`make_batched_decode_loop`, `make_batched_verify_loop`) to record
+
+  - every PROGRAM BUILD, keyed by factory + static config
+    (e.g. ``batched_scan[k=4,mode=greedy,window=None]``), and
+  - every DISPATCH SIGNATURE per program — the (dtype, shape) tuple of each
+    array argument (list args by length) — since jit caches per abstract
+    value, each distinct signature is a distinct XLA lowering.
+
+`run_scenario` drives the real BatchEngine through a fixed tiny-model
+script: prefill (8+1 chunks), K-step scans, pipelined chaining, draft-verify
+blocks, a stochastic row, and a durable-resume admission. The observed
+manifest is diffed against the pinned ``perf/compile_manifest.json``:
+
+  - a program key absent from the pin  -> finding (new compiled program)
+  - a signature absent under its key   -> finding (new dispatch shape)
+  - observed ⊂ pinned                  -> ok (scheduling may not exercise
+    every pinned shape on every run; the gate is one-sided by design)
+
+When a new dispatch shape is INTENTIONAL (a new feature legitimately adds a
+program), re-pin with ``python perf/dlint.py --update-manifest`` and review
+the manifest diff like any other lockfile (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from contextlib import ExitStack
+
+from .core import REPO, Finding
+
+MANIFEST_PATH = os.path.join(REPO, "perf", "compile_manifest.json")
+_MANIFEST_REL = os.path.join("perf", "compile_manifest.json")
+
+
+def _describe(a) -> str:
+    """Compact, stable descriptor of one dispatch argument."""
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        return f"{a.dtype}{tuple(a.shape)}"
+    if isinstance(a, (list, tuple)):
+        if a and isinstance(a[0], (list, tuple)):
+            return f"list({len(a)}x{len(a[0])})"
+        return f"list({len(a)})"
+    if isinstance(a, dict):
+        return "tree"
+    if isinstance(a, (bool, int, float)):
+        return type(a).__name__
+    return type(a).__name__
+
+
+class CompileAudit:
+    """Records program builds + dispatch signatures while active (a context
+    manager patching the factory modules; nesting is not supported)."""
+
+    def __init__(self):
+        # key -> {"builds": int, "signatures": set[str]}
+        self.programs: dict[str, dict] = {}
+        self._stack: ExitStack | None = None
+
+    # -- recording ------------------------------------------------------
+
+    def _program(self, key: str) -> dict:
+        if key not in self.programs:
+            self.programs[key] = {"builds": 0, "signatures": set()}
+        return self.programs[key]
+
+    def record_build(self, key: str) -> None:
+        self._program(key)["builds"] += 1
+
+    def record_call(self, key: str, args: tuple) -> None:
+        sig = " ".join(_describe(a) for a in args)
+        self._program(key)["signatures"].add(sig)
+
+    def _wrap(self, key: str, fn):
+        def wrapped(*args, **kw):
+            self.record_call(key, args)
+            return fn(*args, **kw)
+
+        return wrapped
+
+    def _patch_factory(self, module, name: str, keyfn):
+        orig = getattr(module, name)
+
+        def factory(*args, **kw):
+            key = keyfn(*args, **kw)
+            self.record_build(key)
+            return self._wrap(key, orig(*args, **kw))
+
+        setattr(module, name, factory)
+        self._stack.callback(setattr, module, name, orig)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def __enter__(self) -> "CompileAudit":
+        from ..runtime import device_loop, engine
+
+        self._stack = ExitStack()
+
+        def _static(kw):
+            return (f"mode={kw.get('mode', 'greedy')},"
+                    f"window={kw.get('attn_window')}")
+
+        self._patch_factory(
+            engine, "make_sharded_forward",
+            lambda spec, mesh, params, **kw:
+                f"forward_step[window={kw.get('attn_window')}]")
+        self._patch_factory(
+            device_loop, "make_decode_loop",
+            lambda spec, mesh, params, n, **kw:
+                f"decode_loop[n={n},{_static(kw)}]")
+        self._patch_factory(
+            device_loop, "make_batched_decode_loop",
+            lambda spec, mesh, params, n, **kw:
+                f"batched_scan[k={n},{_static(kw)}]")
+        self._patch_factory(
+            device_loop, "make_batched_verify_loop",
+            lambda spec, mesh, params, t, **kw:
+                f"verify[t={t},{_static(kw)}]")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stack.close()
+        self._stack = None
+
+    # -- export ---------------------------------------------------------
+
+    def manifest(self) -> dict:
+        return {"programs": {
+            key: {"builds": rec["builds"],
+                  "signatures": sorted(rec["signatures"])}
+            for key, rec in sorted(self.programs.items())}}
+
+
+# ----------------------------------------------------------------------
+# the fixed scenario script
+# ----------------------------------------------------------------------
+
+def scenario_spec():
+    """Tiny 2-layer model, seq_len 64 (< the window-bucket floor, so exactly
+    one forward-step window compiles) — the same scale the spec-amortize and
+    fault-matrix tier-1 gates run at."""
+    from ..models.spec import ArchType, ModelSpec, RopeType
+
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=64, rope_type=RopeType.LLAMA).resolved()
+
+
+def run_scenario(keep_engine: bool = False):
+    """Drive the real BatchEngine through every serving phase the manifest
+    pins: prefill (8+1 chunks), greedy K-step scans with pipelined chaining,
+    a stochastic scan row, draft-verify blocks on a repetitive prompt, and a
+    durable-resume admission (which must reuse the existing programs, not
+    mint new ones). Deterministic by construction: fixed prompts, fixed
+    seeds, phases serialized by wait()."""
+    from ..models.params import init_random_params
+    from ..quants import FloatType
+    from ..runtime.batch_engine import BatchEngine
+    from ..runtime.sampler import Sampler
+
+    spec = scenario_spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    eng = BatchEngine(spec, params, slots=2, superstep=4, pipeline=True,
+                      speculative=4, spec_min_draft=1, tp=1,
+                      prefix_cache=True)
+    V = spec.vocab_size
+    ok = False
+    try:
+        # phase 1 — prefill + greedy scans + pipelined chain: two co-batched
+        # greedy requests; 9-token prompts prefill as one 8-chunk + one
+        # 1-chunk; 12 decode tokens at k=4 exercise chained super-steps.
+        # Non-repetitive prompts keep the n-gram drafts empty (scan path).
+        p1 = [(7 * i + 3) % V for i in range(9)]
+        p2 = [(11 * i + 5) % V for i in range(9)]
+        r1 = eng.submit(p1, 12, Sampler(V))
+        r2 = eng.submit(p2, 12, Sampler(V))
+        r1.wait(60)
+        r2.wait(60)
+        # phase 2 — stochastic scan: one seeded sampled request alone, so
+        # the sample-mode scan program (and its rng upload shape) pins.
+        rs = eng.submit(p1, 8, Sampler(V, temperature=0.8, seed=7))
+        out_s = rs.wait(60)
+        # phase 3 — draft-verify: a repetitive prompt makes the per-slot
+        # NgramIndex propose full drafts, engaging the (B, T) verify blocks.
+        rep = [9, 21, 33] * 6
+        rv = eng.submit(rep, 12, Sampler(V))
+        rv.wait(60)
+        # phase 4 — durable resume: re-admit phase 2's request as a
+        # mid-stream failover would (prompt ⊕ delivered, fast-forwarded
+        # sampler). Resume is an ADMISSION property: it must ride the
+        # existing prefill/scan programs — a resume-only program key in the
+        # manifest diff is itself the defect this phase exists to catch.
+        smp = Sampler(V, temperature=0.8, seed=7)
+        smp.fast_forward(len(out_s))
+        rr = eng.submit(p1 + out_s, 6, smp, resume_tokens=len(out_s))
+        rr.wait(60)
+        ok = True
+    finally:
+        # a failed phase must not leak a live engine (scheduler thread +
+        # params + KV caches for the rest of the process) — keep_engine
+        # hands the engine out only on success
+        if not keep_engine or not ok:
+            eng.close()
+    return eng if keep_engine else None
+
+
+# ----------------------------------------------------------------------
+# manifest diff / pin
+# ----------------------------------------------------------------------
+
+def load_manifest(path: str | None = None) -> dict | None:
+    path = path or MANIFEST_PATH
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except OSError:
+        return None
+
+
+def diff_manifest(observed: dict, pinned: dict | None) -> list[Finding]:
+    """Findings for every observed program/signature the pin does not cover.
+    One-sided: pinned-but-unobserved entries are fine (scheduling may skip
+    shapes on a given run)."""
+    if pinned is None:
+        return [Finding("compile-manifest", _MANIFEST_REL, 0,
+                        "pinned manifest missing — run "
+                        "`python perf/dlint.py --update-manifest`")]
+    pinned_programs = pinned.get("programs", {})
+    findings = []
+    for key, rec in sorted(observed.get("programs", {}).items()):
+        pin = pinned_programs.get(key)
+        if pin is None:
+            findings.append(Finding(
+                "compile-manifest", _MANIFEST_REL, 0,
+                f"recompile creep: program {key} compiled but is not in the "
+                "pinned manifest (new cache key; if intentional, re-pin "
+                "with `python perf/dlint.py --update-manifest`)"))
+            continue
+        known = set(pin.get("signatures", []))
+        for sig in sorted(rec["signatures"]):
+            if sig not in known:
+                findings.append(Finding(
+                    "compile-manifest", _MANIFEST_REL, 0,
+                    f"recompile creep: program {key} dispatched at a new "
+                    f"signature [{sig}] — a fresh XLA lowering on the "
+                    "serving path (shape leak or dtype drift; if "
+                    "intentional, re-pin)"))
+    return findings
+
+
+def check_manifest(manifest_path: str | None = None) -> list[Finding]:
+    """Run the scenario under audit and diff against the pin (the
+    `compile_gate=True` arm of analysis/runner.py)."""
+    audit = CompileAudit()
+    with audit:
+        run_scenario()
+    return diff_manifest(audit.manifest(), load_manifest(manifest_path))
+
+
+def update_manifest(path: str | None = None) -> dict:
+    """Re-run the scenario and pin the observed manifest. The diff against
+    the previous pin is MERGED (union), never shrunk implicitly: shapes a
+    particular run didn't exercise must not silently fall out of the pin —
+    delete retired programs by hand, with review."""
+    path = path or MANIFEST_PATH
+    audit = CompileAudit()
+    with audit:
+        run_scenario()
+    observed = audit.manifest()
+    prev = load_manifest(path)
+    if prev is not None:
+        for key, rec in prev.get("programs", {}).items():
+            mine = observed["programs"].setdefault(
+                key, {"builds": rec.get("builds", 0), "signatures": []})
+            mine["signatures"] = sorted(
+                set(mine["signatures"]) | set(rec.get("signatures", [])))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(observed, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return observed

@@ -1,0 +1,131 @@
+"""Docs-drift lints (rules `metric-docs`, `fault-docs`).
+
+Two hand-maintained inventories rot silently unless machine-checked:
+
+- **metric-docs** (migrated from perf/smoke_lint.py, where its first run
+  found 6 undocumented metrics): every literal-named
+  `metrics.counter/gauge/histogram(...)` registration in the package must
+  appear in docs/OBSERVABILITY.md as a delimited token.
+- **fault-docs** (new): every `faults.fire("point", ...)` injection point in
+  the package must appear in docs/ROBUSTNESS.md's injection-point inventory
+  — the inventory has been hand-extended across PRs 4/6/8/9 and a point
+  missing from it is invisible to operators writing DLLAMA_FAULTS configs
+  and to the fault-matrix reviewers.
+
+Both match the doc as a DELIMITED token, not a substring: `prefix_cache_hit`
+must not ride on `prefix_cache_hit_tokens_total`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import REPO, Finding, Source
+
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+OBS_DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+ROBUSTNESS_DOC = os.path.join(REPO, "docs", "ROBUSTNESS.md")
+
+
+def _delimited(token: str, doc: str) -> bool:
+    return re.search(r"(?<![A-Za-z0-9_.])" + re.escape(token)
+                     + r"(?![A-Za-z0-9_])", doc) is not None
+
+
+def _package_sources(sources: list[Source]) -> list[Source]:
+    pkg = "distributed_llama_tpu" + os.sep
+    return [s for s in sources if s.relpath.startswith(pkg)]
+
+
+# ----------------------------------------------------------------------
+# metric registrations
+# ----------------------------------------------------------------------
+
+def collect_metric_registrations(sources: list[Source],
+                                 package_only: bool = True
+                                 ) -> list[tuple[str, str, int]]:
+    """[(metric name, relpath, line)] for every literal-named
+    counter()/gauge()/histogram() call in the package sources. Matches both
+    module conveniences (`metrics.counter(...)`) and registry methods
+    (`REGISTRY.counter(...)`) by attribute name, and bare-name calls after a
+    from-import by function name; non-literal first args are skipped (none
+    exist today, and a dynamic name needs its own doc story anyway)."""
+    out = []
+    for src in (_package_sources(sources) if package_only else sources):
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name not in _METRIC_FACTORIES:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                              str):
+                out.append((first.value, src.relpath, node.lineno))
+    return sorted(set(out))
+
+
+def check_metric_docs(sources: list[Source],
+                      doc_path: str = OBS_DOC) -> list[Finding]:
+    try:
+        with open(doc_path, encoding="utf-8") as fh:
+            doc = fh.read()
+    except OSError:
+        return [Finding("metric-docs", os.path.relpath(doc_path, REPO), 0,
+                        "missing — the metric inventory has nowhere to live")]
+    return [Finding("metric-docs", path, line,
+                    f"metric '{name}' is not documented in "
+                    "docs/OBSERVABILITY.md (add it to the inventory)")
+            for name, path, line in collect_metric_registrations(sources)
+            if not _delimited(name, doc)]
+
+
+# ----------------------------------------------------------------------
+# fault injection points
+# ----------------------------------------------------------------------
+
+def collect_fault_points(sources: list[Source]) -> list[tuple[str, str, int]]:
+    """[(point name, relpath, line)] for every literal-named
+    `faults.fire("...")` (or bare `fire("...")` after a from-import) in the
+    package. The framework's own module is excluded — its `fire` definitions
+    and docstrings are not injection points."""
+    out = []
+    for src in _package_sources(sources):
+        if src.tree is None or src.relpath.endswith(
+                os.path.join("resilience", "faults.py")):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name != "fire":
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                              str):
+                out.append((first.value, src.relpath, node.lineno))
+    return sorted(set(out))
+
+
+def check_fault_docs(sources: list[Source],
+                     doc_path: str = ROBUSTNESS_DOC) -> list[Finding]:
+    try:
+        with open(doc_path, encoding="utf-8") as fh:
+            doc = fh.read()
+    except OSError:
+        return [Finding("fault-docs", os.path.relpath(doc_path, REPO), 0,
+                        "missing — the injection-point inventory has "
+                        "nowhere to live")]
+    return [Finding("fault-docs", path, line,
+                    f"fault point '{point}' is not documented in "
+                    "docs/ROBUSTNESS.md's injection-point inventory")
+            for point, path, line in collect_fault_points(sources)
+            if not _delimited(point, doc)]

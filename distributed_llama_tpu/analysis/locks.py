@@ -1,0 +1,271 @@
+"""Lock-discipline checker (rules `lock-guard`, `lock-blocking`).
+
+The serving stack's concurrency correctness is hand-maintained: the
+BatchEngine scheduler, HTTP handler threads, the membership poller, and the
+flight recorder all share mutable state behind plain `threading.Lock`s, and
+nothing verified the discipline until a race reached hardware. This pass
+machine-checks two invariants the reviewers previously re-derived by hand:
+
+1. **lock-guard** — an attribute declared guarded (a `# guards: a, b`
+   comment on the line creating the lock, e.g.
+   `self._plock = threading.Lock()  # guards: _pending`) may only be read or
+   written inside the owning class under a lexical `with self.<lock>:`
+   block, or in a method annotated `# holds: self.<lock>`. `__init__` is
+   exempt (construction happens-before publication). Accesses from OUTSIDE
+   the class are out of scope — the convention is per-class ownership.
+
+2. **lock-blocking** — while any of the class's declared locks is lexically
+   held, calls that can block indefinitely are flagged: `time.sleep`,
+   zero-positional-arg `.join()` (Thread/Process join — `",".join(xs)`
+   passes an iterable and is ignored), `.getresponse()` / `.request()` /
+   `urlopen` / `socket.*` connection traffic, `.accept()` / `.recv()`,
+   `.block_until_ready()`, `np.asarray` on device arrays can't be told
+   apart syntactically so it is left to the hot-path pass, `.wait()` on
+   anything that is NOT the held lock itself (`Condition.wait` RELEASES the
+   lock it is called on and is the correct idiom), `open()` and queue
+   `.get()` with no `_nowait`. This is the exact bug class behind scheduler
+   stalls: one slow HTTP read under the membership lock stalls every router
+   thread.
+
+Both rules are triaged per finding: real ones get fixed, benign ones carry
+`# dlint: ignore[rule] -- reason` (analysis/core.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Source, comment_on, marker_on
+
+_LOCK_TYPES = ("Lock", "RLock", "Condition")
+_GUARDS_RE = re.compile(r"#\s*guards:\s*([A-Za-z0-9_,.\s]+)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z0-9_,.\s]+)")
+
+# blocking call names matched on the ATTRIBUTE (x.<name>(...)) or bare name
+_BLOCKING_ATTRS = {"getresponse", "accept", "recv", "block_until_ready",
+                   "urlopen", "request", "connect", "sendall"}
+_BLOCKING_BARE = {"urlopen", "open", "input"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = (fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else None)
+    return name in _LOCK_TYPES
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for an ast node `self.x`, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _names(raw: str) -> list[str]:
+    return [n.strip().removeprefix("self.")
+            for n in raw.split(",") if n.strip()]
+
+
+class _ClassLocks:
+    """Lock declarations of one class: {lock attr: [guarded attrs]}."""
+
+    def __init__(self):
+        self.locks: dict[str, list[str]] = {}
+
+    @property
+    def guarded(self) -> dict[str, str]:
+        return {a: lk for lk, attrs in self.locks.items() for a in attrs}
+
+
+def _is_lock_field(node: ast.AST) -> bool:
+    """dataclass-style `x: Lock = field(default_factory=threading.Lock)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = (fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else None)
+    if name != "field":
+        return False
+    for kw in node.keywords:
+        if kw.arg == "default_factory":
+            fac = kw.value
+            fac_name = (fac.attr if isinstance(fac, ast.Attribute)
+                        else fac.id if isinstance(fac, ast.Name) else None)
+            return fac_name in _LOCK_TYPES
+    return False
+
+
+def _collect_locks(source: Source, cls: ast.ClassDef) -> _ClassLocks:
+    out = _ClassLocks()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            targets = node.targets
+        elif (isinstance(node, ast.AnnAssign) and node.value is not None
+              and isinstance(node.target, ast.Name)
+              and (_is_lock_ctor(node.value)
+                   or _is_lock_field(node.value))):
+            # dataclass field declaration: the target is a bare class-level
+            # name, which becomes `self.<name>` at runtime
+            out.locks[node.target.id] = _guards_at(source, node.lineno)
+            continue
+        else:
+            continue
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            out.locks[attr] = _guards_at(source, node.lineno)
+    return out
+
+
+def _guards_at(source: Source, lineno: int) -> list[str]:
+    m = _GUARDS_RE.search(comment_on(source, lineno))
+    return _names(m.group(1)) if m else []
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(self, source: Source, cls_name: str, locks: _ClassLocks,
+                 held_at_entry: set[str], findings: list[Finding]):
+        self.source = source
+        self.cls_name = cls_name
+        self.locks = locks
+        self.guarded = locks.guarded
+        self.held: set[str] = set(held_at_entry)
+        self.findings = findings
+
+    # -- lock tracking --------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            # `with self._lock:` and `with self._lock, other:` forms; also
+            # `with self._cond:` (Condition acquires its lock). Helper forms
+            # (`with self._lock.something():`) are not recognized — the
+            # convention is plain `with lock`.
+            attr = _self_attr(item.context_expr)
+            if attr in self.locks.locks and attr not in self.held:
+                acquired.append(attr)
+                self.held.add(attr)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for attr in acquired:
+            self.held.discard(attr)
+
+    # nested defs run at a different time than the enclosing lock region:
+    # their bodies are checked as unheld (closures dispatched later must not
+    # inherit the lexical lock context)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        inner = _MethodChecker(self.source, self.cls_name, self.locks,
+                               set(), self.findings)
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- guarded attribute accesses -------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and attr in self.guarded:
+            lock = self.guarded[attr]
+            if lock not in self.held:
+                verb = ("written" if isinstance(node.ctx,
+                                                (ast.Store, ast.Del))
+                        else "read")
+                self.findings.append(Finding(
+                    "lock-guard", self.source.relpath, node.lineno,
+                    f"{self.cls_name}.{attr} {verb} outside "
+                    f"`with self.{lock}` (declared `# guards: {attr}`)"))
+        self.generic_visit(node)
+
+    # -- blocking calls under a held lock -------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            blocking = self._blocking_name(node)
+            if blocking is not None:
+                held = ", ".join(sorted(self.held))
+                self.findings.append(Finding(
+                    "lock-blocking", self.source.relpath, node.lineno,
+                    f"blocking call {blocking} while holding "
+                    f"self.{held} — a stall here wedges every thread "
+                    "contending on the lock"))
+        self.generic_visit(node)
+
+    def _blocking_name(self, node: ast.Call) -> str | None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            # module-attr forms: time.sleep(...), socket.create_connection
+            if isinstance(fn.value, ast.Name):
+                mod, name = fn.value.id, fn.attr
+                if (mod, name) == ("time", "sleep"):
+                    return "time.sleep()"
+                if mod == "socket":
+                    return f"socket.{name}()"
+            if fn.attr == "join" and not node.args:
+                return ".join()"
+            if fn.attr == "wait":
+                # Condition.wait on the HELD lock releases it — correct;
+                # Event.wait / anything-else.wait blocks while holding
+                recv = _self_attr(fn.value)
+                if recv is not None and recv in self.held:
+                    return None
+                return ".wait()"
+            if fn.attr == "get" and _is_blocking_get(node):
+                return ".get()"
+            if fn.attr in _BLOCKING_ATTRS:
+                return f".{fn.attr}()"
+        elif isinstance(fn, ast.Name) and fn.id in _BLOCKING_BARE:
+            return f"{fn.id}()"
+        return None
+
+
+def _is_blocking_get(node: ast.Call) -> bool:
+    """True for queue-shaped blocking `.get()` forms: bare `q.get()`,
+    `q.get(timeout=...)`, `q.get(True)`, `q.get(block=True)`. A first
+    positional arg that is not the literal True reads as `dict.get(key)`
+    (exempt), and an explicit `block=False` is non-blocking."""
+    if node.args:
+        first = node.args[0]
+        return isinstance(first, ast.Constant) and first.value is True
+    for kw in node.keywords:
+        if kw.arg == "block":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+    return True  # bare get() / get(timeout=...): blocks
+
+
+def check_locks(sources: list[Source]) -> list[Finding]:
+    findings: list[Finding] = []
+    for source in sources:
+        if source.tree is None:
+            continue
+        for cls in ast.walk(source.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _collect_locks(source, cls)
+            if not locks.locks:
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in ("__init__", "__post_init__"):
+                    continue  # construction happens-before publication
+                held = set()
+                m = marker_on(source, meth, _HOLDS_RE)
+                if m:
+                    held = {h for h in _names(m.group(1))
+                            if h in locks.locks}
+                checker = _MethodChecker(source, cls.name, locks, held,
+                                         findings)
+                for stmt in meth.body:
+                    checker.visit(stmt)
+    return findings

@@ -1,0 +1,111 @@
+"""Unified static-analysis runner (CLI: perf/dlint.py, tier-1:
+tests/test_dlint.py).
+
+Composes every pass over one shared parse of the repo:
+
+  compile / dead-import      repo-wide      (analysis/smoke.py, migrated)
+  lock-guard / lock-blocking package-wide   (analysis/locks.py)
+  hot-sync / hot-impure      package-wide   (analysis/hotpath.py)
+  metric-docs / fault-docs   package-wide   (analysis/drift.py)
+  bad-suppression            repo-wide      (analysis/core.py)
+
+plus, opted in separately because it executes the tiny-model engine
+(`compile_gate=True` / `perf/dlint.py --compile-gate`):
+
+  compile-manifest           runtime        (analysis/compile_audit.py)
+
+The report separates unsuppressed findings (gate tier-1 at zero) from
+suppressed ones (each carrying its written reason) and lists stale
+suppressions that matched nothing — an excuse that outlived its defect
+should be deleted, not trusted.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from . import drift, hotpath, locks, smoke
+from .core import (REPO, Finding, Source, apply_suppressions, load_sources,
+                   repo_py_files)
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    unused_suppressions: list[dict] = field(default_factory=list)
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def counts_by_rule(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.unsuppressed:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def as_dict(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "findings": [f.as_dict() for f in self.unsuppressed],
+            "suppressions": [f.as_dict() for f in self.suppressed],
+            "unused_suppressions": self.unused_suppressions,
+            "counts": {
+                "unsuppressed": len(self.unsuppressed),
+                "suppressed": len(self.suppressed),
+                "by_rule": self.counts_by_rule(),
+            },
+            "ok": not self.unsuppressed,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.as_dict(), indent=2, sort_keys=True)
+
+    def format_text(self) -> str:
+        lines = [f.format() for f in self.unsuppressed]
+        lines.append(
+            f"dlint: {self.files_scanned} files, "
+            f"{len(self.unsuppressed)} finding(s), "
+            f"{len(self.suppressed)} suppressed, "
+            f"{len(self.unused_suppressions)} stale suppression(s)")
+        return "\n".join(lines)
+
+
+def run(files: list[str] | None = None, repo: str = REPO,
+        compile_gate: bool = False, manifest_path: str | None = None
+        ) -> Report:
+    """Run every static pass (and optionally the runtime compile-manifest
+    gate) and return the triaged report."""
+    paths = files if files is not None else repo_py_files(repo)
+    sources = load_sources(paths, repo)
+    findings: list[Finding] = []
+    findings.extend(smoke.check_compile(paths, repo))
+    findings.extend(smoke.check_dead_imports(sources, repo))
+    findings.extend(locks.check_locks(sources))
+    findings.extend(hotpath.check_hot_paths(sources))
+    findings.extend(drift.check_metric_docs(sources))
+    findings.extend(drift.check_fault_docs(sources))
+    for s in sources:
+        findings.extend(getattr(s, "bad_suppressions", ()))
+    if compile_gate:
+        from . import compile_audit
+
+        findings.extend(compile_audit.check_manifest(manifest_path))
+    apply_suppressions(sources, findings)
+    report = Report(findings=findings, files_scanned=len(sources))
+    for s in sources:
+        for sup in s.suppressions.values():
+            if sup.used == 0:
+                report.unused_suppressions.append(
+                    {"path": sup.path, "line": sup.line,
+                     "rules": list(sup.rules), "reason": sup.reason})
+    return report
+
+
+__all__ = ["Report", "run", "Source", "Finding"]

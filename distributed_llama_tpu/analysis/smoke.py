@@ -1,0 +1,108 @@
+"""Repo-wide syntax + dead-import passes (rules `compile`, `dead-import`).
+
+Migrated from perf/smoke_lint.py (which remains as a thin shim so the
+tier-1 test names don't churn):
+
+- **compile** — byte-compiles every first-party .py, so a syntax error in a
+  rarely-imported app path (the class of defect that survives a test suite
+  importing only what it tests) fails tier-1 instead of the first prod run.
+- **dead-import** — pyflakes when available; otherwise a conservative AST
+  fallback: an import-bound name is flagged only when its identifier appears
+  NOWHERE else in the file text (docstrings and `__all__` strings count as
+  uses, `# noqa` on the import line opts out), so false positives are
+  structurally impossible for any name the file mentions at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import compileall
+import os
+import re
+
+from .core import REPO, Finding, Source
+
+
+def check_compile(files: list[str], repo: str = REPO) -> list[Finding]:
+    findings = []
+    for f in files:
+        # quiet=2 silences listings; failure prints to stderr AND returns False
+        if not compileall.compile_file(f, quiet=2, force=False):
+            findings.append(Finding("compile", os.path.relpath(f, repo), 0,
+                                    "failed to byte-compile"))
+    return findings
+
+
+def _pyflakes_check(files: list[str],
+                    repo: str = REPO) -> list[Finding] | None:
+    """Full pyflakes run when the tool is importable; None = unavailable."""
+    try:
+        from pyflakes.api import checkPath
+        from pyflakes.reporter import Reporter
+    except ImportError:
+        return None
+    import io
+
+    out, err = io.StringIO(), io.StringIO()
+    rep = Reporter(out, err)
+    n = 0
+    for f in files:
+        n += checkPath(f, rep)
+    if n == 0:
+        return []
+    findings = []
+    for ln in (out.getvalue() + err.getvalue()).splitlines():
+        # only unused-import findings gate; other pyflakes classes advisory
+        if "imported but unused" not in ln:
+            continue
+        m = re.match(r"([^:]+):(\d+):(?:\d+:)?\s*(.*)", ln)
+        if m:
+            findings.append(Finding(
+                "dead-import", os.path.relpath(m.group(1), repo),
+                int(m.group(2)), m.group(3)))
+        else:
+            findings.append(Finding("dead-import", ln, 0, ln))
+    return findings
+
+
+def fallback_dead_imports(source: Source) -> list[Finding]:
+    """Names bound by import statements that the file never mentions again."""
+    if os.path.basename(source.path) == "__init__.py":
+        return []  # re-export surface: unused-looking imports are the point
+    if source.tree is None:
+        return []  # the compile pass reports this
+    findings = []
+    bound: list[tuple[str, int]] = []
+    for node in ast.walk(source.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound.append(((a.asname or a.name.split(".")[0]),
+                              node.lineno))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                bound.append(((a.asname or a.name), node.lineno))
+    for name, lineno in bound:
+        if "noqa" in source.line_text(lineno):
+            continue
+        # a name is "used" if it appears anywhere else in the file at all
+        # (code, strings, __all__, docstrings) — maximally conservative
+        uses = len(re.findall(rf"\b{re.escape(name)}\b", source.text))
+        if uses <= 1:
+            findings.append(Finding("dead-import", source.relpath, lineno,
+                                    f"'{name}' imported but unused"))
+    return findings
+
+
+def check_dead_imports(sources: list[Source],
+                       repo: str = REPO) -> list[Finding]:
+    via_pyflakes = _pyflakes_check([s.path for s in sources], repo)
+    if via_pyflakes is not None:
+        return via_pyflakes
+    findings: list[Finding] = []
+    for s in sources:
+        findings.extend(fallback_dead_imports(s))
+    return findings

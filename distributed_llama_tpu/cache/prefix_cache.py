@@ -81,7 +81,7 @@ class PrefixCache:
         self.block_tokens = block_tokens
         self.radix = RadixIndex(block_tokens)
         self.pool = KVBlockPool(max_blocks, hot_blocks=hot_blocks, q80=q80)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: radix, hits, misses, unused_hits, hit_tokens, resident_tokens, evicted_blocks, prompt_tokens
         # per-instance accounting (the module counters aggregate all instances).
         # hits/hit_tokens count APPLIED seeds (mark_seeded), not mere matches —
         # a match the slot rewind already covered served nothing from the pool

@@ -40,7 +40,7 @@ class AffinityMap:
         self.block_bytes = block_bytes
         self.max_nodes = max_nodes
         self._radix = RadixIndex(block_tokens=block_bytes)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _radix
 
     def lookup(self, key: bytes, alive: set[str]) -> tuple[str | None, int]:
         """(replica id, shared full blocks) for the deepest recorded route

@@ -138,7 +138,7 @@ class RequestJournal:
     def __init__(self, max_inflight: int = 4096):
         self.max_inflight = max_inflight
         self._live: dict[str, JournalEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _live, _seq
         self._seq = 0
 
     def open(self, body: dict, stream: bool,

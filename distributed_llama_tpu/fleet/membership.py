@@ -50,7 +50,19 @@ _HASH_MISMATCH = metrics.counter(
 @dataclass
 class Replica:
     """One api_server behind the router. Health/load fields are the last
-    poll's reading; `inflight` is the router's own live proxy count."""
+    poll's reading; `inflight` is the router's own live proxy count.
+
+    The health/load block is mutated from TWO thread families — the
+    background poller (`Membership._poll`) and every proxy handler thread
+    (`Membership.mark_failed`, inflight counting) — so all mutation goes
+    through the `_lock`-holding methods below and readers that combine
+    several fields (`load_score`, `snapshot`) take the lock too. The
+    pre-fix code mutated fields bare: concurrent `mark_failed`s could lose
+    `consecutive_failures` increments (feeding the backoff exponent), and a
+    reader could observe a half-applied poll (e.g. `healthy=True` already
+    set while `status` still said `"unreachable"`). Found by the
+    lock-guard pass (docs/ANALYSIS.md); pinned by
+    tests/test_fleet.py::test_replica_status_mutation_is_atomic."""
 
     host: str
     port: int
@@ -80,7 +92,7 @@ class Replica:
     next_poll_t: float = 0.0       # monotonic; 0 = poll normally
     down_since: float = 0.0        # monotonic of the first failed poll
     last_down_log: float = 0.0     # rate-limits the "still down" line
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)  # guards: healthy, draining, status, consecutive_failures, slots, free_slots, queue_depth, model_hash, pid, uptime_s, inflight, last_ok
 
     def __post_init__(self):
         if not self.id:
@@ -89,15 +101,54 @@ class Replica:
     def load_score(self) -> tuple:
         """Least-loaded ordering: fewest waiting+in-flight first, then most
         free slots, then id for determinism."""
-        return (self.queue_depth + self.inflight, -self.free_slots, self.id)
+        with self._lock:
+            return (self.queue_depth + self.inflight, -self.free_slots,
+                    self.id)
 
     def snapshot(self) -> dict:
-        return {"id": self.id, "healthy": self.healthy,
-                "draining": self.draining, "status": self.status,
-                "model_hash": self.model_hash, "slots": self.slots,
-                "free_slots": self.free_slots,
-                "queue_depth": self.queue_depth, "inflight": self.inflight,
-                "pid": self.pid, "uptime_s": self.uptime_s}
+        with self._lock:
+            return {"id": self.id, "healthy": self.healthy,
+                    "draining": self.draining, "status": self.status,
+                    "model_hash": self.model_hash, "slots": self.slots,
+                    "free_slots": self.free_slots,
+                    "queue_depth": self.queue_depth,
+                    "inflight": self.inflight,
+                    "pid": self.pid, "uptime_s": self.uptime_s}
+
+    def mark_unreachable(self, clear_draining: bool = False) -> int:
+        """Atomic ejection bookkeeping (poller failure path AND proxy-path
+        `mark_failed`): returns the new consecutive-failure count for the
+        caller's backoff math."""
+        with self._lock:
+            self.healthy = False
+            if clear_draining:
+                self.draining = False
+            self.status = "unreachable"
+            self.consecutive_failures += 1
+            return self.consecutive_failures
+
+    def apply_poll(self, status: str, ok: bool, block: dict) -> float:
+        """Fold one successful /healthz response in atomically; returns the
+        PREVIOUS uptime reading (the caller's restart detection)."""
+        with self._lock:
+            self.status = status
+            self.healthy = ok
+            self.draining = (status == "draining"
+                             or bool(block.get("draining")))
+            self.slots = int(block.get("slots", self.slots) or 0)
+            self.free_slots = int(block.get("free_slots",
+                                            self.free_slots) or 0)
+            self.queue_depth = int(block.get("queue_depth",
+                                             self.queue_depth) or 0)
+            self.model_hash = block.get("model_hash", self.model_hash)
+            prev_uptime = self.uptime_s
+            self.pid = int(block.get("pid", self.pid) or 0)
+            self.uptime_s = float(block.get("uptime_s",
+                                            self.uptime_s) or 0.0)
+            if ok:
+                self.consecutive_failures = 0
+                self.last_ok = time.monotonic()
+            return prev_uptime
 
 
 def parse_addr(addr: str) -> tuple[str, int]:
@@ -177,10 +228,7 @@ class Membership:
             finally:
                 conn.close()
         except Exception:
-            rep.healthy = False
-            rep.draining = False
-            rep.status = "unreachable"
-            rep.consecutive_failures += 1
+            rep.mark_unreachable(clear_draining=True)
             _POLLS.labels(outcome="unreachable").inc()
             self._note_unreachable(rep)
             return
@@ -193,22 +241,14 @@ class Membership:
         status = body.get("status",
                           "ok" if resp.status == 200 else "unhealthy")
         block = body.get("replica") or {}
-        rep.status = status
-        rep.healthy = resp.status == 200 and status == "ok"
-        rep.draining = status == "draining" or bool(block.get("draining"))
-        rep.slots = int(block.get("slots", rep.slots) or 0)
-        rep.free_slots = int(block.get("free_slots", rep.free_slots) or 0)
-        rep.queue_depth = int(block.get("queue_depth", rep.queue_depth) or 0)
-        rep.model_hash = block.get("model_hash", rep.model_hash)
-        prev_uptime = rep.uptime_s
-        rep.pid = int(block.get("pid", rep.pid) or 0)
-        rep.uptime_s = float(block.get("uptime_s", rep.uptime_s) or 0.0)
+        ok = resp.status == 200 and status == "ok"
+        # one atomic application: a concurrent load_score/snapshot (proxy
+        # threads routing) must never see a half-applied poll
+        prev_uptime = rep.apply_poll(status, ok, block)
         if prev_uptime and rep.uptime_s and rep.uptime_s < prev_uptime:
             print(f"⚠️  replica {rep.id} restarted between polls "
                   f"(uptime {prev_uptime:.0f}s -> {rep.uptime_s:.0f}s)")
-        if rep.healthy:
-            rep.consecutive_failures = 0
-            rep.last_ok = time.monotonic()
+        if ok:
             if rep.model_hash:
                 if self._fleet_hash is None:
                     self._fleet_hash = rep.model_hash
@@ -266,9 +306,7 @@ class Membership:
     def mark_failed(self, rep: Replica) -> None:
         """Proxy-path ejection: a connect/read failure takes the replica out
         of rotation NOW; the poller re-admits it on the next clean poll."""
-        rep.healthy = False
-        rep.status = "unreachable"
-        rep.consecutive_failures += 1
+        rep.mark_unreachable()
         _IN_ROTATION.set(len(self.in_rotation()))
 
     def least_loaded(self, exclude: set[str] = frozenset()

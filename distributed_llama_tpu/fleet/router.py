@@ -100,7 +100,7 @@ class RouterState:
         self.journal = RequestJournal(max_inflight=journal_inflight)
         self._rng = random.Random(seed)
         self._rr = 0  # round-robin clock for least-loaded ties
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _rng, _rr
 
     # ------------------------------------------------------------------
     # routing decision

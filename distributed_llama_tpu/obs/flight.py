@@ -56,11 +56,11 @@ class FlightRecorder:
         self.slow_threshold_ms = slow_threshold * 1000.0
         self._live: "OrderedDict[str, dict]" = OrderedDict()
         self._done: "OrderedDict[str, dict]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _live, _done, evicted_done, evicted_live
         # separate lock for the slow-log file: writes happen OUTSIDE the
         # table lock (file I/O must not stall the scheduler's event path)
         # but concurrent finishes must not interleave lines or double-open
-        self._log_lock = threading.Lock()
+        self._log_lock = threading.Lock()  # guards: _slow_fh
         self._slow_fh = None
         self.evicted_done = 0   # completed records rotated out of the ring
         self.evicted_live = 0   # live records dropped at live_capacity
@@ -180,7 +180,7 @@ class FlightRecorder:
         try:
             with self._log_lock:
                 if self._slow_fh is None:
-                    self._slow_fh = open(self.slow_log, "a")
+                    self._slow_fh = open(self.slow_log, "a")  # dlint: ignore[lock-blocking] -- the log lock EXISTS to serialize this fd; only finish() paths contend, never the event() hot path
                 self._slow_fh.write(line + "\n")
                 self._slow_fh.flush()
         except OSError:
@@ -221,6 +221,12 @@ class FlightRecorder:
         with self._lock:
             done = [self._summary(r, False) for r in self._done.values()]
             live = [self._summary(r, True) for r in self._live.values()]
+            # eviction counters snapshotted in the SAME critical section as
+            # the tables: reading them after releasing the lock could pair
+            # a pre-eviction listing with a post-eviction count (or a torn
+            # counter) whenever a finish races the listing — found by the
+            # lock-guard pass (docs/ANALYSIS.md)
+            evicted, evicted_live = self.evicted_done, self.evicted_live
         if slowest > 0:
             done = sorted(done, key=lambda r: r.get("e2e_ms") or 0.0,
                           reverse=True)[:slowest]
@@ -228,8 +234,8 @@ class FlightRecorder:
         else:
             done.reverse()  # newest first
         return {"completed": done, "live": live,
-                "capacity": self.capacity, "evicted": self.evicted_done,
-                "evicted_live": self.evicted_live}
+                "capacity": self.capacity, "evicted": evicted,
+                "evicted_live": evicted_live}
 
     def close(self) -> None:
         with self._log_lock:

@@ -92,7 +92,7 @@ class _Metric:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._children: dict[tuple[str, ...], _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _children
         self._init_value()
 
     def _init_value(self) -> None:
@@ -256,7 +256,7 @@ class Histogram(_Metric):
 class Registry:
     def __init__(self):
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _metrics
 
     def _get_or_create(self, cls, name: str, help: str, labelnames=(), **kw):
         with self._lock:

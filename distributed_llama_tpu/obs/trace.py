@@ -128,7 +128,7 @@ class Tracer:
         assert capacity > 0
         self.capacity = capacity
         self._events: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _events, _thread_names, dropped_events
         self._annotate = jax_annotations
         self._epoch_ns = time.perf_counter_ns()
         self._wall_start = time.time()
@@ -192,12 +192,15 @@ class Tracer:
 
     # -- export ---------------------------------------------------------
 
-    def events(self) -> list[dict]:
-        """Snapshot of buffered events (oldest first), plus process/thread
-        metadata."""
+    def _snapshot(self) -> tuple[list[dict], dict, int]:
+        """(events, thread names, dropped count) taken in ONE critical
+        section, so an export can never pair a pre-drop event list with a
+        post-drop counter (the torn-pair class the lock-guard pass flags)."""
         with self._lock:
-            evs = list(self._events)
-            names = dict(self._thread_names)
+            return (list(self._events), dict(self._thread_names),
+                    self.dropped_events)
+
+    def _meta_events(self, names: dict) -> list[dict]:
         meta = []
         if self.process_name:
             meta.append({"name": "process_name", "ph": "M", "pid": self.pid,
@@ -205,19 +208,26 @@ class Tracer:
         meta.extend({"name": "thread_name", "ph": "M", "pid": self.pid,
                      "tid": tid, "args": {"name": tname}}
                     for tid, tname in sorted(names.items()))
-        return meta + evs
+        return meta
+
+    def events(self) -> list[dict]:
+        """Snapshot of buffered events (oldest first), plus process/thread
+        metadata."""
+        evs, names, _dropped = self._snapshot()
+        return self._meta_events(names) + evs
 
     def to_chrome_trace(self) -> dict:
         """The Chrome trace-event JSON object (load in Perfetto as-is).
         `wall_start_unix` is the wall clock at the tracer's monotonic epoch —
         the alignment anchor merge_chrome_traces() shifts each process's
         timestamps by, so a fleet's traces share one timeline."""
+        evs, names, dropped = self._snapshot()  # ONE critical section
         return {
-            "traceEvents": self.events(),
+            "traceEvents": self._meta_events(names) + evs,
             "displayTimeUnit": "ms",
             "otherData": {
                 "wall_start_unix": self._wall_start,
-                "dropped_events": self.dropped_events,
+                "dropped_events": dropped,
                 "pid": self.pid,
                 "process_name": self.process_name,
             },

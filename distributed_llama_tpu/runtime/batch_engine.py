@@ -392,7 +392,7 @@ class BatchEngine:
         # overflow requests with no free slot; guarded by _plock (close() may run while
         # the scheduler thread is still finishing a long device step)
         self._pending: list[BatchRequest] = []
-        self._plock = threading.Lock()
+        self._plock = threading.Lock()  # guards: _pending
         # Batched speculative decoding (docs/SERVING.md "Speculative
         # decoding"): spec_k > 0 drafts up to k tokens per row from the
         # slot's NgramIndex and verifies every row's block in ONE (B, 1+k)
@@ -423,7 +423,7 @@ class BatchEngine:
         self._shutdown = False
         self._draining = False  # drain mode: serve in-flight, refuse new
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards: _thread
         # scheduler epoch (resilience/supervisor.py): recover_wedged() bumps
         # it to abandon a scheduler thread stuck in a hung device call — the
         # stale thread observes the bump at its next epoch check and unwinds
@@ -570,7 +570,9 @@ class BatchEngine:
         """True while the scheduler thread can serve (running, or not yet
         lazily started). False only after the thread died — the /healthz
         liveness signal."""
-        t = self._thread
+        # single atomic reference read on a health-probe path: taking _lock
+        # here would make /healthz contend with _ensure_thread/recover_wedged
+        t = self._thread  # dlint: ignore[lock-guard] -- atomic ref snapshot; staleness only skews one health probe
         return t is None or t.is_alive()
 
     def load_stats(self) -> dict:
@@ -716,8 +718,16 @@ class BatchEngine:
         self._shutdown = True
         with self._cond:
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
+        # snapshot the scheduler ref under its lock (a concurrent
+        # recover_wedged may swap it mid-close; joining the OLD reference
+        # after the swap would wait on an abandoned zombie while the fresh
+        # scheduler kept serving a closed engine) — but join OUTSIDE the
+        # lock: holding it through a 30 s join would block _ensure_thread
+        # and recover_wedged for the whole drain
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=30)
         # detach the watchdog callback IF it is still ours (a later engine
         # may have claimed the gauge): a bound method left on the
         # module-global gauge would pin this engine's params + KV caches
@@ -1214,7 +1224,7 @@ class BatchEngine:
                 if not self._shutdown:
                     self._cond.wait(timeout=0.05)
 
-    def _emit(self, slot: _Slot, token: int) -> bool:
+    def _emit(self, slot: _Slot, token: int) -> bool:  # hot-path
         """Deliver one sampled token to the request (output list, stats,
         on_token stream) and run the host-side finish checks. Returns False
         when the request finished (slot released). slot.pos must already count
@@ -1243,7 +1253,7 @@ class BatchEngine:
                 return False
             return True
 
-    def _advance_row(self, slot: _Slot) -> bool:
+    def _advance_row(self, slot: _Slot) -> bool:  # hot-path
         """Ensure slot.last_token holds the row's next un-ingested token —
         either the device-sampled tail of the previous super-step block, or a
         fresh host-side sample from last_logits (with delivery + finish
@@ -1523,6 +1533,7 @@ class BatchEngine:
         fl = self._issue_verify_step(rows, t, ndraft, props, budget, starts)
         self._pipeline_advance(fl)
 
+    # hot-path
     def _issue_verify_step(self, rows: list, t: int, ndraft: list[int],
                            props: list[list[int]], budget: list[int],
                            starts: list[int]) -> _InflightStep:
@@ -1647,7 +1658,7 @@ class BatchEngine:
                 self._inflight = nxt
         _PIPELINE_DEPTH.set(1 if self._inflight is not None else 0)
 
-    def _plan_chain(self, fl: _InflightStep):
+    def _plan_chain(self, fl: _InflightStep):  # hot-path
         """Speculative schedule for the scan super-step after `fl`, assuming
         `fl` delivers every budgeted token: same rows, re-derived budgets
         from the expected positions/output lengths. Returns (rows, starts,
@@ -1701,6 +1712,7 @@ class BatchEngine:
             return None
         return rows, starts, budget, clamp
 
+    # hot-path
     def _issue_super_step(self, rows: list, k: int, budget: list[int],
                           starts: list[int],
                           chain: _InflightStep | None = None) -> _InflightStep:
@@ -1764,6 +1776,7 @@ class BatchEngine:
         return _InflightStep(rows, k, starts, budget, temps, toks, tok, pos,
                              rng_out, t_issue, chain is not None)
 
+    # hot-path
     def _deliver_super_step(self, fl: _InflightStep) -> dict[int, str]:
         """Host-side delivery of an issued super-step: block on the (K, B)
         token transfer, then per row run EOS/stop/max checks, emit tokens,
@@ -1779,9 +1792,9 @@ class BatchEngine:
                                              "tokens": sum(fl.budget),
                                              "kind": fl.kind,
                                              "chained": fl.chained}):
-            toks = np.asarray(fl.toks)  # (k, B): blocks until the device lands
-            rng_out = np.asarray(fl.rng)
-            acc = np.asarray(fl.acc) if fl.kind == "verify" else None
+            toks = np.asarray(fl.toks)  # dlint: ignore[hot-sync] -- THE delivery fence: one (K,B) block transfer per super-step is the design (1 sync per K tokens)
+            rng_out = np.asarray(fl.rng)  # dlint: ignore[hot-sync] -- rides the same fence; copy_to_host_async at issue makes this a pickup, not a stall
+            acc = np.asarray(fl.acc) if fl.kind == "verify" else None  # dlint: ignore[hot-sync] -- same fence (verify accept lengths)
         if self._epoch != epoch:
             # a hung transfer is the other place a wedged thread blocks; an
             # abandoned thread waking here must not deliver into slots that

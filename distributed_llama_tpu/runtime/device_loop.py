@@ -187,6 +187,7 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
                             attn_window=attn_window, cache_write=cache_write,
                             fused_prologue=fused_prologue)
 
+    # hot-path: traced
     def loop(p, rope_cos, rope_sin, token, kc, vc, start_pos, key, temperature, topp):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
 
@@ -217,6 +218,7 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     donate = (4, 5) if donate_cache else ()
     jitted = jax.jit(sharded, donate_argnums=donate)
 
+    # hot-path
     def run(p, rope: RopeTables, token, kc, vc, start_pos, key, temperature=0.0,
             topp=0.9):
         faults.fire("device_loop.dispatch", n_steps=n_steps)
@@ -290,6 +292,7 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
                             attn_window=attn_window, cache_write=cache_write,
                             fused_prologue=fused_prologue)
 
+    # hot-path: traced
     def loop(p, rope_cos, rope_sin, tokens, kc, vc, start_pos, rng_hi, rng_lo,
              temperature, topp, budget):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
@@ -336,6 +339,7 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
     donate = (4, 5) if donate_cache else ()
     jitted = jax.jit(sharded, donate_argnums=donate)
 
+    # hot-path
     def run(p, rope: RopeTables, tokens, kc, vc, start_pos, rng, temperature,
             topp, budget):
         faults.fire("device_loop.batched_dispatch", n_steps=n_steps)
@@ -412,6 +416,7 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
                             attn_window=attn_window, cache_write=cache_write,
                             fused_prologue=fused_prologue)
 
+    # hot-path: traced
     def loop(p, rope_cos, rope_sin, proposals, kc, vc, start_pos, rng_hi,
              rng_lo, temperature, topp, ndraft):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
@@ -470,6 +475,7 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
     donate = (4, 5) if donate_cache else ()
     jitted = jax.jit(sharded, donate_argnums=donate)
 
+    # hot-path
     def run(p, rope: RopeTables, proposals, kc, vc, start_pos, rng,
             temperature, topp, ndraft):
         faults.fire("device_loop.verify_dispatch", block=block)

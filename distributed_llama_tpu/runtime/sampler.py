@@ -43,6 +43,7 @@ class Sampler:
     def set_seed(self, seed: int) -> None:
         self.state = np.uint64(seed)
 
+    # hot-path
     def _coin(self) -> float:
         self.state, u = _random_u32(self.state)
         return (u >> 8) / 16777216.0  # randomF32, utils.cpp:88-90
@@ -61,8 +62,8 @@ class Sampler:
         for _ in range(n_tokens):
             self.state, _ = _random_u32(self.state)
 
-    def sample(self, logits: np.ndarray) -> int:
-        logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]
+    def sample(self, logits: np.ndarray) -> int:  # hot-path
+        logits = np.asarray(logits, dtype=np.float32).reshape(-1)[: self.vocab_size]  # dlint: ignore[hot-sync] -- logits arrive host-side (the dispatch fence already paid the transfer); this is a dtype/shape normalize
         if self.temperature == 0.0:
             return int(np.argmax(logits))
         probs = _softmax(logits / self.temperature)
@@ -71,6 +72,7 @@ class Sampler:
             return self._sample_mult(probs, coin)
         return self._sample_topp(probs, coin)
 
+    # hot-path
     def _sample_mult(self, probs: np.ndarray, coin: float) -> int:
         cdf = np.cumsum(probs)
         idx = int(np.searchsorted(cdf, coin, side="right"))
@@ -83,6 +85,7 @@ class Sampler:
     # overlaps with device decode (docs/SERVING.md "Pipelined decode")
     _TOPP_SELECT = 64
 
+    # hot-path
     def _sample_topp(self, probs: np.ndarray, coin: float) -> int:
         """Nucleus sampling with the reference's cutoff pre-filter
         (tokenizer.cpp:328-369), the sort taken over an np.argpartition
@@ -122,7 +125,7 @@ class Sampler:
             r = coin * csum[last]
             pick = int(np.searchsorted(csum[: last + 1], r, side="right"))
             pick = min(pick, last)
-            return int(order[pick])
+            return int(order[pick])  # dlint: ignore[hot-sync] -- order is host numpy (argsort of a host probs row); no device array reaches this function
 
     def _sample_topp_full(self, probs: np.ndarray, coin: float) -> int:
         """The pre-selection full-survivor-sort nucleus path, kept verbatim

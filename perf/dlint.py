@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Unified static-analysis runner (docs/ANALYSIS.md) — the tier-1 lint gate.
+
+Passes (distributed_llama_tpu/analysis/):
+
+  compile / dead-import        repo-wide byte-compile + unused-import lint
+  lock-guard / lock-blocking   `# guards:` lock-discipline checker
+  hot-sync / hot-impure        `# hot-path` host-sync + trace-purity lint
+  metric-docs / fault-docs     inventory drift vs OBSERVABILITY/ROBUSTNESS
+  bad-suppression              reasonless `# dlint: ignore[...]` markers
+  compile-manifest             (--compile-gate) tiny-model recompile audit
+                               vs the pinned perf/compile_manifest.json
+
+Usage:
+
+  python perf/dlint.py                     # static passes, text output
+  python perf/dlint.py --json out.json     # + machine-readable artifact
+  python perf/dlint.py --compile-gate      # + the runtime compile audit
+  python perf/dlint.py --update-manifest   # re-pin the compile manifest
+                                           # (union-merge; review the diff)
+
+Exit 0 when every finding is suppressed (each suppression carries a written
+reason), 1 otherwise. Tier-1 wiring: tests/test_dlint.py gates at zero
+unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# the compile gate drives the real engine: keep it off any accelerator a
+# stray environment would grab (callers may still override explicitly)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the findings/suppressions summary as JSON "
+                         "(BENCH-artifact convention: perf/DLINT.json)")
+    ap.add_argument("--compile-gate", action="store_true",
+                    help="also run the tiny-model compile-manifest audit "
+                         "(imports jax, ~tens of seconds on CPU)")
+    ap.add_argument("--manifest", metavar="PATH", default=None,
+                    help="pinned manifest path (default "
+                         "perf/compile_manifest.json)")
+    ap.add_argument("--update-manifest", action="store_true",
+                    help="re-run the audit scenario and re-pin the manifest "
+                         "(union-merged with the existing pin)")
+    args = ap.parse_args(argv)
+
+    if args.update_manifest:
+        from distributed_llama_tpu.analysis import compile_audit
+
+        manifest = compile_audit.update_manifest(args.manifest)
+        path = args.manifest or compile_audit.MANIFEST_PATH
+        n_sigs = sum(len(p["signatures"])
+                     for p in manifest["programs"].values())
+        print(f"pinned {len(manifest['programs'])} programs / {n_sigs} "
+              f"dispatch signatures -> {path}")
+        print("review the manifest diff like a lockfile before committing")
+        return 0
+
+    from distributed_llama_tpu.analysis import runner
+
+    report = runner.run(compile_gate=args.compile_gate,
+                        manifest_path=args.manifest)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(report.to_json() + "\n")
+    for f in report.unsuppressed:
+        print(f.format(), file=sys.stderr)
+    print(report.format_text().splitlines()[-1])
+    return 1 if report.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

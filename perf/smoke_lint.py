@@ -1,189 +1,80 @@
 #!/usr/bin/env python
-"""Repo-wide syntax + dead-import + metric-docs smoke (wired into tier-1 via
-tests/test_smoke_lint.py).
+"""Thin shim over distributed_llama_tpu/analysis/ (ISSUE 10).
 
-Three passes:
+The three original smoke passes — compileall, dead-import lint, metric-docs
+drift — migrated into the unified static-analysis subsystem:
 
-1. **compileall** — byte-compiles every .py, so a syntax error in a
-   rarely-imported app path (the class of defect that survives a test suite
-   importing only what it tests) fails tier-1 instead of the first prod run.
-2. **dead-import lint** — pyflakes when available; otherwise a conservative
-   AST fallback: an import-bound name is flagged only when its identifier
-   appears NOWHERE else in the file text (docstrings and `__all__` strings
-   count as uses, `# noqa` on the import line opts out), so false positives
-   are structurally impossible for any name the file mentions at all.
-3. **metric-docs drift lint** — statically collects every
-   `metrics.counter/gauge/histogram("name", ...)` registration in the
-   `distributed_llama_tpu` package and fails when any name is absent from
-   docs/OBSERVABILITY.md's inventory. The doc rotted silently once (PR 2's
-   inventory missed later additions until a reviewer diffed by hand); now a
-   metric cannot ship undocumented.
+    compile / dead-import  -> analysis/smoke.py
+    metric-docs            -> analysis/drift.py
+    runner / CLI           -> analysis/runner.py + perf/dlint.py
 
-Run directly (`python perf/smoke_lint.py`) for CI/git-hook use: exit 0 clean,
-1 with findings on stderr.
+This module keeps the original function surface (string findings, same
+names) so tier-1's tests/test_smoke_lint.py and any git hooks calling
+`python perf/smoke_lint.py` keep working unchanged. New passes (lock
+discipline, hot-path syncs, fault-point drift, the compile-manifest gate)
+live behind `perf/dlint.py` only — this shim stays frozen.
 """
 
 from __future__ import annotations
 
-import ast
-import compileall
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# directories holding first-party python (skips caches, .git, jax caches)
-_SCAN_DIRS = ("distributed_llama_tpu", "tests", "perf", "examples")
-_TOP_FILES = ("bench.py", "launch.py", "__graft_entry__.py")
+from distributed_llama_tpu.analysis import core as _core  # noqa: E402
+from distributed_llama_tpu.analysis import drift as _drift  # noqa: E402
+from distributed_llama_tpu.analysis import smoke as _smoke  # noqa: E402
+
+REPO = _core.REPO
+_OBS_DOC = _drift.OBS_DOC
 
 
 def repo_py_files() -> list[str]:
-    out = []
-    for d in _SCAN_DIRS:
-        for root, dirs, files in os.walk(os.path.join(REPO, d)):
-            dirs[:] = [x for x in dirs if not x.startswith((".", "__pycache__"))]
-            out.extend(os.path.join(root, f) for f in files if f.endswith(".py"))
-    out.extend(os.path.join(REPO, f) for f in _TOP_FILES
-               if os.path.exists(os.path.join(REPO, f)))
-    return sorted(out)
+    return _core.repo_py_files()
+
+
+def _fmt(f) -> str:
+    loc = f"{f.path}:{f.line}" if f.line else f.path
+    return f"{loc}: {f.message}"
 
 
 def check_compile(files: list[str]) -> list[str]:
-    errors = []
-    for f in files:
-        # quiet=2 silences listings; failure prints to stderr AND returns False
-        if not compileall.compile_file(f, quiet=2, force=False):
-            errors.append(f"{os.path.relpath(f, REPO)}: failed to byte-compile")
-    return errors
-
-
-def _pyflakes_check(files: list[str]) -> list[str] | None:
-    """Full pyflakes run when the tool is importable; None = unavailable."""
-    try:
-        from pyflakes.api import checkPath
-        from pyflakes.reporter import Reporter
-    except ImportError:
-        return None
-    import io
-
-    out, err = io.StringIO(), io.StringIO()
-    rep = Reporter(out, err)
-    n = 0
-    for f in files:
-        n += checkPath(f, rep)
-    if n == 0:
-        return []
-    lines = [ln for ln in (out.getvalue() + err.getvalue()).splitlines() if ln]
-    # only unused-import findings gate; other pyflakes classes are advisory
-    return [ln for ln in lines if "imported but unused" in ln]
-
-
-def _fallback_dead_imports(path: str, src: str) -> list[str]:
-    """Names bound by import statements that the file never mentions again."""
-    if os.path.basename(path) == "__init__.py":
-        return []  # re-export surface: unused-looking imports are the point
-    try:
-        tree = ast.parse(src)
-    except SyntaxError:
-        return []  # the compile pass reports this
-    lines = src.splitlines()
-    findings = []
-    bound: list[tuple[str, int]] = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                bound.append(((a.asname or a.name.split(".")[0]), node.lineno))
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                bound.append(((a.asname or a.name), node.lineno))
-    for name, lineno in bound:
-        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-        if "noqa" in line:
-            continue
-        # a name is "used" if it appears anywhere else in the file at all
-        # (code, strings, __all__, docstrings) — maximally conservative
-        uses = len(re.findall(rf"\b{re.escape(name)}\b", src))
-        if uses <= 1:
-            findings.append(f"{os.path.relpath(path, REPO)}:{lineno}: "
-                            f"'{name}' imported but unused")
-    return findings
+    return [_fmt(f) for f in _smoke.check_compile(files)]
 
 
 def check_dead_imports(files: list[str]) -> list[str]:
-    via_pyflakes = _pyflakes_check(files)
-    if via_pyflakes is not None:
-        return via_pyflakes
-    findings = []
-    for f in files:
-        with open(f, encoding="utf-8") as fh:
-            findings.extend(_fallback_dead_imports(f, fh.read()))
-    return findings
+    return [_fmt(f) for f in _smoke.check_dead_imports(
+        _core.load_sources(files))]
 
 
-_METRIC_FACTORIES = ("counter", "gauge", "histogram")
-_OBS_DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+def _fallback_dead_imports(path: str, src: str) -> list[str]:
+    """Original signature kept for tests: lint one (path, source) pair with
+    the conservative AST fallback."""
+    import ast
+
+    relpath = os.path.relpath(path, REPO)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        tree = None
+    source = _core.Source(path, relpath, src, src.splitlines(), tree)
+    return [_fmt(f) for f in _smoke.fallback_dead_imports(source)]
 
 
 def collect_metric_names(files: list[str] | None = None
                          ) -> list[tuple[str, str]]:
-    """[(metric name, relpath)] for every literal-named
-    counter()/gauge()/histogram() registration inside the package.
-
-    Matches both the module conveniences (`metrics.counter("x", ...)`) and
-    registry methods (`REGISTRY.counter(...)`, `reg.gauge(...)`) by the
-    ATTRIBUTE name; bare-name calls (`counter(...)` after a from-import)
-    are matched by function name. Non-literal first arguments are skipped —
-    there are none today, and a dynamic name would need its own doc story
-    anyway. Scope is the package only: tests and perf register bench-only
-    scratch metrics that never reach a production /metrics."""
     if files is None:
-        files = [f for f in repo_py_files()
-                 if os.path.relpath(f, REPO).startswith(
-                     "distributed_llama_tpu" + os.sep)]
-    out = []
-    for path in files:
-        with open(path, encoding="utf-8") as fh:
-            src = fh.read()
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue  # the compile pass reports this
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
-                continue
-            fn = node.func
-            name = (fn.attr if isinstance(fn, ast.Attribute)
-                    else fn.id if isinstance(fn, ast.Name) else None)
-            if name not in _METRIC_FACTORIES:
-                continue
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(first.value,
-                                                              str):
-                out.append((first.value, os.path.relpath(path, REPO)))
-    return sorted(set(out))
+        sources = _core.load_sources(_core.package_py_files())
+    else:
+        sources = _core.load_sources(files)
+    regs = _drift.collect_metric_registrations(sources, package_only=False)
+    return sorted({(name, path) for name, path, _line in regs})
 
 
 def check_metric_docs() -> list[str]:
-    """Every registered metric name must appear in docs/OBSERVABILITY.md —
-    as a DELIMITED token, not a substring: a bare `in` test would let a new
-    metric ride on any documented name it happens to prefix (e.g.
-    `prefix_cache_hit` passing via `prefix_cache_hit_tokens_total`)."""
-    try:
-        with open(_OBS_DOC, encoding="utf-8") as fh:
-            doc = fh.read()
-    except OSError:
-        return [f"{os.path.relpath(_OBS_DOC, REPO)}: missing — the metric "
-                "inventory has nowhere to live"]
-    return [f"{path}: metric '{name}' is not documented in "
-            "docs/OBSERVABILITY.md (add it to the inventory)"
-            for name, path in collect_metric_names()
-            if not re.search(r"(?<![A-Za-z0-9_])" + re.escape(name)
-                             + r"(?![A-Za-z0-9_])", doc)]
+    sources = _core.load_sources(_core.package_py_files())
+    return [_fmt(f) for f in _drift.check_metric_docs(sources)]
 
 
 def main() -> int:

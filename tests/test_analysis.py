@@ -1,0 +1,369 @@
+"""Unit tests for the static-analysis passes (distributed_llama_tpu/analysis/,
+ISSUE 10): fixture modules with KNOWN violations assert each rule fires at
+exactly the expected line, stays quiet on the compliant twin, and that the
+suppression convention is honored, counted, and rejects reasonless markers."""
+
+import textwrap
+
+from distributed_llama_tpu.analysis import core, drift, hotpath, locks
+
+
+def make_source(text: str, relpath: str = "distributed_llama_tpu/fx.py"):
+    text = textwrap.dedent(text)
+    lines = text.splitlines()
+    import ast
+
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        tree = None
+    sups, bad = core.parse_suppressions("/fx/" + relpath, relpath, lines, text)
+    src = core.Source("/fx/" + relpath, relpath, text, lines, tree, sups)
+    src.bad_suppressions = bad
+    return src
+
+
+# ----------------------------------------------------------------------
+# lock-guard
+# ----------------------------------------------------------------------
+
+def test_lock_guard_fires_on_unguarded_access():
+    src = make_source("""
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()  # guards: _queue, _thread
+                self._queue = []
+                self._thread = None
+
+            def good(self):
+                with self._lock:
+                    self._queue.append(1)
+
+            def bad_read(self):
+                return len(self._queue)
+
+            def bad_write(self):
+                self._thread = None
+    """)
+    fs = locks.check_locks([src])
+    assert [(f.rule, f.line) for f in fs] == [("lock-guard", 15),
+                                             ("lock-guard", 18)]
+    assert "_queue read outside" in fs[0].message
+    assert "_thread written outside" in fs[1].message
+
+
+def test_lock_guard_holds_annotation_and_init_exempt():
+    src = make_source("""
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()  # guards: _queue
+                self._queue = []  # construction: exempt
+
+            def _drain(self):  # holds: self._lock
+                self._queue.clear()
+
+            def outer(self):
+                with self._lock:
+                    self._drain()
+    """)
+    assert locks.check_locks([src]) == []
+
+
+def test_lock_guard_dataclass_field_lock():
+    src = make_source("""
+        import threading
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class Replica:
+            healthy: bool = False
+            _lock: threading.Lock = field(default_factory=threading.Lock)  # guards: healthy
+
+            def eject(self):
+                self.healthy = False
+
+            def eject_locked(self):
+                with self._lock:
+                    self.healthy = False
+    """)
+    fs = locks.check_locks([src])
+    assert [(f.rule, f.line) for f in fs] == [("lock-guard", 11)]
+
+
+def test_lock_guard_closure_does_not_inherit_lock():
+    """A nested def runs later (dispatch closure): its body must be checked
+    as NOT holding the lexically enclosing lock."""
+    src = make_source("""
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()  # guards: _queue
+                self._queue = []
+
+            def plan(self):
+                with self._lock:
+                    def later():
+                        return self._queue.pop()
+                    return later
+    """)
+    fs = locks.check_locks([src])
+    assert [(f.rule, f.line) for f in fs] == [("lock-guard", 12)]
+
+
+# ----------------------------------------------------------------------
+# lock-blocking
+# ----------------------------------------------------------------------
+
+def test_lock_blocking_fires_under_held_lock():
+    src = make_source("""
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def bad_join(self, t):
+                with self._lock:
+                    t.join()
+
+            def bad_http(self, conn):
+                with self._lock:
+                    return conn.getresponse()
+
+            def fine_outside(self, t):
+                time.sleep(0.1)
+                t.join()
+    """)
+    fs = locks.check_locks([src])
+    assert [(f.rule, f.line) for f in fs] == [
+        ("lock-blocking", 11), ("lock-blocking", 15), ("lock-blocking", 19)]
+    assert "time.sleep()" in fs[0].message
+
+
+def test_lock_blocking_condition_wait_and_str_join_exempt():
+    src = make_source("""
+        import threading
+
+        class Sched:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def idle(self, parts):
+                with self._cond:
+                    self._cond.wait(timeout=0.1)  # releases the lock: fine
+                    return ",".join(parts)  # str.join: takes a positional arg
+    """)
+    assert locks.check_locks([src]) == []
+
+
+# ----------------------------------------------------------------------
+# hot-path
+# ----------------------------------------------------------------------
+
+def test_hot_sync_rules_fire_only_in_marked_functions():
+    src = make_source("""
+        import numpy as np
+
+        def unmarked(x):
+            return np.asarray(x)  # not hot: no finding
+
+        def deliver(x, acc, i):  # hot-path
+            a = x.tolist()
+            b = np.asarray(x)
+            c = int(acc[i])
+            print("token")
+            return a, b, c
+    """)
+    fs = hotpath.check_hot_paths([src])
+    assert [(f.rule, f.line) for f in fs] == [
+        ("hot-sync", 8), ("hot-sync", 9), ("hot-sync", 10), ("hot-sync", 11)]
+    assert all("deliver" in f.message for f in fs)
+
+
+def test_hot_sync_host_name_tracking_exempts_fetched_arrays():
+    """The one designed sync (np.asarray at the delivery fence) is flagged;
+    downstream .tolist()/int(x[i]) on the SAME name are host ops, not new
+    syncs — one triage point per transfer, not one per use."""
+    src = make_source("""
+        import numpy as np
+
+        def deliver(fl, i):  # hot-path
+            toks = np.asarray(fl.toks)
+            block = toks[:4, i].tolist()
+            return int(toks[0, i]), block
+    """)
+    fs = hotpath.check_hot_paths([src])
+    assert [(f.rule, f.line) for f in fs] == [("hot-sync", 5)]
+
+
+def test_hot_impure_fires_in_traced_bodies_only():
+    src = make_source("""
+        import time
+        import random
+
+        def host_side():  # hot-path
+            return time.perf_counter()  # host timing is fine
+
+        def step(carry, i):  # hot-path: traced
+            t = time.time()
+            r = random.random()
+            return carry, (t, r)
+    """)
+    fs = hotpath.check_hot_paths([src])
+    assert [(f.rule, f.line) for f in fs] == [
+        ("hot-impure", 9), ("hot-impure", 10)]
+
+
+# ----------------------------------------------------------------------
+# suppressions
+# ----------------------------------------------------------------------
+
+def test_suppression_honored_and_counted():
+    src = make_source("""
+        import numpy as np
+
+        def deliver(x):  # hot-path
+            return np.asarray(x)  # dlint: ignore[hot-sync] -- the delivery fence
+    """)
+    fs = core.apply_suppressions([src], hotpath.check_hot_paths([src]))
+    assert len(fs) == 1 and fs[0].suppressed
+    assert fs[0].reason == "the delivery fence"
+    assert src.suppressions[5].used == 1
+
+
+def test_suppression_wrong_rule_does_not_silence():
+    src = make_source("""
+        import numpy as np
+
+        def deliver(x):  # hot-path
+            return np.asarray(x)  # dlint: ignore[lock-guard] -- wrong rule
+    """)
+    fs = core.apply_suppressions([src], hotpath.check_hot_paths([src]))
+    assert len(fs) == 1 and not fs[0].suppressed
+    assert src.suppressions[5].used == 0  # stale: reported, silences nothing
+
+
+def test_suppression_star_matches_any_rule():
+    src = make_source("""
+        import numpy as np
+
+        def deliver(x):  # hot-path
+            return np.asarray(x)  # dlint: ignore[*] -- fence (multiple rules)
+    """)
+    fs = core.apply_suppressions([src], hotpath.check_hot_paths([src]))
+    assert fs[0].suppressed
+
+
+def test_reasonless_suppression_is_a_finding():
+    src = make_source("""
+        def f():
+            return 1  # dlint: ignore[hot-sync]
+    """)
+    bad = src.bad_suppressions
+    assert len(bad) == 1 and bad[0].rule == "bad-suppression"
+    assert bad[0].line == 3
+    assert 3 not in src.suppressions  # and it suppresses nothing
+
+
+def test_suppression_quoted_in_docstring_is_not_parsed():
+    src = make_source('''
+        def f():
+            """Docs may quote `# dlint: ignore[x] -- like this` freely."""
+            return 1
+    ''')
+    assert src.suppressions == {} and src.bad_suppressions == []
+
+
+# ----------------------------------------------------------------------
+# drift lints
+# ----------------------------------------------------------------------
+
+def test_fault_docs_flags_undocumented_point():
+    src = make_source("""
+        from ..resilience import faults
+
+        def f():
+            faults.fire("totally.new_point", slot=1)
+            faults.fire("batch.submit")  # documented: no finding
+    """)
+    fs = drift.check_fault_docs([src])
+    assert len(fs) == 1 and fs[0].rule == "fault-docs"
+    assert "totally.new_point" in fs[0].message and fs[0].line == 5
+
+
+def test_metric_docs_flags_planted_metric():
+    src = make_source("""
+        from .obs import metrics
+
+        M = metrics.counter("totally_undocumented_total", "x")
+        G = metrics.gauge(dynamic_name, "skipped: non-literal name")
+        K = metrics.counter("batch_queue_depth", "documented: no finding")
+    """)
+    fs = drift.check_metric_docs([src])
+    assert len(fs) == 1
+    assert "totally_undocumented_total" in fs[0].message
+
+
+def test_doc_match_is_token_delimited():
+    """`prefix_cache_hit` is a substring of a documented metric name but is
+    NOT itself documented — the delimited matcher must say so."""
+    doc = open(drift.OBS_DOC, encoding="utf-8").read()
+    assert "prefix_cache_hit" in doc            # the naive check passes...
+    assert not drift._delimited("prefix_cache_hit", doc)  # ...the real one won't
+    assert drift._delimited("prefix_cache_hit_tokens_total", doc)
+
+
+def test_hot_impure_propagates_into_nested_traced_defs():
+    """A scan `step` defined inside a jitted `loop` body executes at trace
+    time — impurity inside the nested def is the loop's impurity (the real
+    device_loop bodies have exactly this shape)."""
+    src = make_source("""
+        import time
+
+        def loop(tokens):  # hot-path: traced
+            def step(carry, i):
+                return carry, time.time()
+            return step
+    """)
+    fs = hotpath.check_hot_paths([src])
+    assert [(f.rule, f.line) for f in fs] == [("hot-impure", 6)]
+    assert "loop.step" in fs[0].message
+
+
+def test_lock_blocking_queue_get_forms():
+    """Blocking queue gets flag in every spelling — bare get(), get(True),
+    get(block=True), get(timeout=...) — while dict.get(key) and an explicit
+    block=False stay exempt."""
+    src = make_source("""
+        import threading
+
+        class Sched:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, q):
+                with self._lock:
+                    a = q.get()
+                    b = q.get(True)
+                    c = q.get(block=True)
+                    d = q.get(timeout=1.0)
+                    return a, b, c, d
+
+            def fine(self, q, d):
+                with self._lock:
+                    return q.get(block=False), d.get("key"), q.get_nowait()
+    """)
+    fs = locks.check_locks([src])
+    assert [(f.rule, f.line) for f in fs] == [
+        ("lock-blocking", 10), ("lock-blocking", 11),
+        ("lock-blocking", 12), ("lock-blocking", 13)]

@@ -565,3 +565,72 @@ def test_unknown_routes_and_bad_json(fleet):
                  {"Content-Type": "application/json"})
     assert conn.getresponse().status == 400
 
+
+
+# ----------------------------------------------------------------------
+# Replica status atomicity (ISSUE 10 satellite: lock-guard pass finding)
+# ----------------------------------------------------------------------
+
+def test_replica_status_mutation_is_atomic():
+    """Regression for a lock-guard finding (docs/ANALYSIS.md): Replica
+    health/status used to be mutated bare from BOTH the membership poller
+    thread and every proxy handler thread (`mark_failed`), so concurrent
+    ejections could lose `consecutive_failures` increments (the backoff
+    exponent input) and readers could observe torn states. All mutation now
+    goes through `_lock`-holding Replica methods; this hammers them from 8
+    threads and asserts exact counting plus never-torn snapshots."""
+    from distributed_llama_tpu.fleet.membership import Replica
+
+    rep = Replica("host", 1234)
+    n_threads, n_iter = 8, 300
+    torn: list[dict] = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            snap = rep.snapshot()
+            # atomic invariant: healthy=True only ever coexists with "ok"
+            # (apply_poll sets both in one critical section)
+            if snap["healthy"] and snap["status"] != "ok":
+                torn.append(snap)
+
+    def hammer(k: int):
+        barrier.wait()
+        for i in range(n_iter):
+            if (i + k) % 3 == 0:
+                rep.apply_poll("ok", True, {"slots": 2, "free_slots": 1,
+                                            "queue_depth": i})
+            else:
+                rep.mark_unreachable()
+
+    barrier = threading.Barrier(n_threads)
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
+    threads = [threading.Thread(target=hammer, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join(timeout=5)
+    assert not torn, f"torn replica snapshots observed: {torn[:3]}"
+
+    # exact increment accounting: with bare `+= 1` from N threads, CPython's
+    # read-modify-write interleaving can lose updates; under the lock the
+    # count is exact
+    rep2 = Replica("host", 4321)
+    barrier = threading.Barrier(n_threads)
+
+    def eject():
+        barrier.wait()
+        for _ in range(n_iter):
+            rep2.mark_unreachable()
+
+    threads = [threading.Thread(target=eject) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rep2.consecutive_failures == n_threads * n_iter
+    assert rep2.status == "unreachable" and not rep2.healthy

@@ -522,3 +522,59 @@ def test_flight_module_level_noop_and_ctx_resolution():
         assert rec.get("") is None
     finally:
         flight_mod.uninstall()
+
+
+def test_flight_eviction_counters_consistent_with_listing():
+    """Regression for a lock-guard finding (docs/ANALYSIS.md): requests()
+    used to read `evicted_done`/`evicted_live` AFTER releasing the table
+    lock, so a listing racing a finish could pair a pre-eviction completed
+    list with a post-eviction count. The counters are now snapshotted in the
+    same critical section; this drives concurrent finishers against readers
+    and asserts the final listing accounts for every completion exactly."""
+    rec = FlightRecorder(capacity=4, live_capacity=64)
+    n_threads, n_each = 6, 50
+    barrier = threading.Barrier(n_threads + 1)
+    stop = threading.Event()
+
+    def finisher(k: int):
+        barrier.wait()
+        for i in range(n_each):
+            rid = f"r{k}-{i}"
+            rec.start(rid)
+            rec.event(rid, "step")
+            rec.finish(rid, "stop")
+
+    violations: list[str] = []
+
+    def reader():
+        # violations collected into a list the MAIN thread asserts on —
+        # an assert raised inside a daemon thread would be swallowed by
+        # threading's excepthook and the test would pass vacuously
+        barrier.wait()
+        while not stop.is_set():
+            r = rec.requests()
+            # within one locked snapshot the ring bound always holds
+            if len(r["completed"]) > rec.capacity:
+                violations.append(f"ring over capacity: {len(r['completed'])}")
+            if r["evicted"] < 0 or r["evicted_live"] < 0:
+                violations.append(f"negative counter: {r['evicted']}, "
+                                  f"{r['evicted_live']}")
+
+    threads = [threading.Thread(target=finisher, args=(k,))
+               for k in range(n_threads)]
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rt.join(timeout=5)
+    assert not violations, violations[:3]
+    final = rec.requests()
+    total = n_threads * n_each
+    assert len(final["completed"]) == rec.capacity
+    # exact accounting: every finish either sits in the ring or was counted
+    # out of it — the invariant the same-critical-section snapshot pins
+    assert final["evicted"] == total - rec.capacity
+    assert final["evicted_live"] == 0
