@@ -400,6 +400,8 @@ def shared_prefix_workload(args, spec):
                         req_e2e.append(fr["e2e_ms"] / 1e3)
                     samples.append({"request_id": f"bench-{label}-{i}",
                                     "cache": label,
+                                    "tenant": "default",
+                                    "class": "interactive",
                                     "ttft_s": ttfts.get(i),
                                     "e2e_s": fr.get("e2e_ms", 0.0) / 1e3
                                     or None,
@@ -708,7 +710,9 @@ def fleet_shared_prefix_workload(args, spec):
         if args.latency_log:
             write_latency_log(args.latency_log, [
                 {"request_id": (r or {}).get("rid"), "group": g,
-                 "follower": f, "ttft_s": (r or {}).get("ttft"),
+                 "follower": f, "tenant": "default",
+                 "class": "interactive",
+                 "ttft_s": (r or {}).get("ttft"),
                  "e2e_s": (r or {}).get("e2e"),
                  "tokens": (r or {}).get("deltas"),
                  "replica": (r or {}).get("replica"),
@@ -1084,6 +1088,7 @@ def chaos_workload(args, spec):
                         failed += 1
                         err = repr(ex)
                     samples.append({"request_id": r.rid, "phase": label,
+                                    "tenant": r.tenant, "class": r.klass,
                                     "ttft_s": ttfts.get(i), "e2e_s": None,
                                     "tokens": len(r.out), "replica": None,
                                     "error": err})
@@ -1344,6 +1349,301 @@ def chaos_fleet_workload(args, spec):
             log.close()
 
 
+def trace_workload(args, spec):
+    """--workload trace: the multi-tenant SLO acceptance bench
+    (docs/SERVING.md "Multi-tenant serving"). A seeded trace-driven load
+    generator — bursty arrivals (on/off-modulated exponential gaps),
+    heavy-tailed lognormal prompt/output lengths, a configurable tenant mix
+    — drives one BatchEngine at ~`--overload`x (default 2x) its MEASURED
+    sustained capacity, and the BENCH json gates the SLO story in-run:
+
+    - interactive TTFT p95 within 1.5x of its uncontended value, plus an
+      absolute floor of the documented admission window (two in-flight
+      K-step dispatches = 2*K*B/capacity wall seconds — milliseconds on
+      accelerators, dominant on a 2-core CI box) and 30 ms timer noise;
+    - ZERO failed interactive requests (batch sheds first: queue-full
+      evictions displace batch, preemption frees slots at super-step
+      boundaries);
+    - batch-class sheds carry honest drain-derived Retry-After (503), the
+      quota-capped tenant sees 429s with bucket-derived Retry-After;
+    - every backlogged unthrottled tenant's delivered-token share within
+      ε of its configured weight (gold:silver:bronze = 3:2:1; the
+      quota-capped fourth tenant is excluded — its share is bound by its
+      bucket, not its weight, and WFQ redistributes what it cannot use).
+
+    Phases: calibrate (measure capacity tok/s + drain), uncontended
+    interactive TTFT baseline, then the overload trace. One engine, shapes
+    warmed by calibration, so the phases compare scheduling — not compiles.
+    """
+    from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.quants import FloatType as _FTy
+    from distributed_llama_tpu.resilience.errors import (EngineSaturated,
+                                                         QuotaExceeded)
+    from distributed_llama_tpu.resilience.tenancy import TenantRegistry
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    rng = np.random.default_rng(args.seed if hasattr(args, "seed") else 0)
+    B = args.batch if args.batch > 0 else 4
+    K = max(args.superstep, 1)
+    weights = {"gold": 3.0, "silver": 2.0, "bronze": 1.0}
+    reg = TenantRegistry.parse(
+        "gold:weight=3;silver:weight=2;bronze:weight=1;capped:weight=1")
+    params = init_random_params(spec, _FTy.Q40, seed=0)
+    be = BatchEngine(spec, params, slots=B, superstep=K, tp=args.tp,
+                     tenants=reg, max_queue=4 * B)
+    greedy = lambda: Sampler(spec.vocab_size, temperature=0.0)  # noqa: E731
+
+    def lens(n, mean_log, sigma, lo, hi):
+        return np.clip(np.exp(rng.normal(mean_log, sigma, n)).astype(int),
+                       lo, hi)
+
+    out = {}
+    try:
+        # --- phase 1: calibrate sustained capacity (also warms shapes) ---
+        # prompt lengths span the PREFILL_CHUNKS buckets (64/8/1) the
+        # heavy-tailed trace will hit: a cold (B, 64) prefill compile
+        # landing MID-TRACE would stall the scheduler ~1s and corrupt the
+        # interactive TTFT gate with XLA time, not scheduling time
+        # (production pre-warms; perf/compile_manifest.json pins shapes)
+        def cal_round(plens):
+            cal = [be.submit(
+                [1] + [int(t) for t in rng.integers(2, 200, plens[
+                    i % len(plens)])],
+                24, greedy(), klass="batch") for i in range(2 * B)]
+            t0 = time.perf_counter()
+            toks = sum(len(r.wait(timeout=600)) for r in cal)
+            return toks / (time.perf_counter() - t0)
+
+        cal_round([150, 80, 24, 10])  # warm compiles across chunk buckets
+        cap_tok_s = cal_round([24, 10, 17, 31])  # measure capacity, not XLA
+
+        # --- phase 2: uncontended interactive TTFT baseline ---
+        def run_interactive(tenant):
+            t_sub = time.perf_counter()
+            first = [None]
+
+            def on_tok(_t):
+                if first[0] is None:
+                    first[0] = time.perf_counter() - t_sub
+            r = be.submit([1] + [int(t) for t in rng.integers(2, 200, 7)],
+                          8, greedy(), on_token=on_tok, tenant=tenant,
+                          klass="interactive")
+            r.wait(timeout=600)
+            return first[0]
+
+        unc = sorted(filter(None, (run_interactive("gold")
+                                   for _ in range(20))))
+        unc_p95 = _pct(unc, 0.95)
+
+        # --- phase 3: the overload trace ---
+        mean_gen = 20.0
+        batch_rps = args.overload * cap_tok_s / mean_gen  # offered, total
+        duration = args.duration
+        n_batch = int(batch_rps * duration)
+        if n_batch > 1500:  # bound the host-side submit work, say so
+            print(f"# arrival cap: {n_batch} -> 1500 batch arrivals "
+                  f"(duration shrinks to keep the {args.overload}x rate)",
+                  file=sys.stderr)
+            n_batch = 1500
+            duration = n_batch / batch_rps
+        events = []  # (t, tenant, klass, prompt_len, gen)
+        share = 1.0 / (len(weights) + 1)  # equal demand incl. capped
+        for tenant in (*weights, "capped"):
+            t = 0.0
+            rate = batch_rps * share
+            n = 0
+            while t < duration and n < n_batch:
+                # bursty: on/off modulation — arrivals at 2.5x the mean
+                # rate during the first 40% of each second, silent after
+                gap = rng.exponential(1.0 / (2.5 * rate))
+                t += gap
+                if (t % 1.0) > 0.4:
+                    t = np.floor(t) + 1.0  # skip to the next burst window
+                if t >= duration:
+                    break
+                events.append((t, tenant, "batch", 0, 0))
+                n += 1
+        # heavy-tailed lengths, assigned after the count is known
+        plens = lens(len(events), 2.2, 0.8, 4, max(spec.seq_len // 3, 8))
+        glens = lens(len(events), 2.8, 0.9, 4, 48)
+        events = [(t, tn, kl, int(p), int(g)) for (t, tn, kl, _p, _g), p, g
+                  in zip(events, plens, glens)]
+        # interactive trickle: gold + silver, one every ~0.6 s each (enough
+        # samples that the p95 gate reads a distribution, not one outlier)
+        for tenant in ("gold", "silver"):
+            t = 0.3
+            while t < duration:
+                events.append((t, tenant, "interactive", 8, 8))
+                t += 0.6
+        events.sort(key=lambda e: e[0])
+        # quota for the capped tenant: half its offered token rate, so the
+        # bucket MUST throttle under the sustained trace
+        capped_tok_s = batch_rps * share * mean_gen
+        reg.set_quota("capped", rate=0.5 * capped_tok_s,
+                      burst=capped_tok_s)
+
+        recs = []
+        t_start = time.perf_counter()
+        for (t_at, tenant, klass, plen, gen) in events:
+            now = time.perf_counter() - t_start
+            if t_at > now:
+                time.sleep(t_at - now)
+            rec = {"tenant": tenant, "class": klass, "gen": gen,
+                   "t_sub": time.perf_counter(), "first": None,
+                   "last": None, "n": 0, "shed": None, "retry_after": None}
+
+            def on_tok(_t, rec=rec):
+                now = time.perf_counter()
+                if rec["first"] is None:
+                    rec["first"] = now
+                rec["last"] = now
+                rec["n"] += 1
+            try:
+                rec["req"] = be.submit(
+                    [1] + [int(x) for x in rng.integers(2, 200, plen)],
+                    gen, greedy(), on_token=on_tok, tenant=tenant,
+                    klass=klass)
+            except QuotaExceeded as e:
+                rec["shed"] = "quota"
+                rec["retry_after"] = e.retry_after
+            except EngineSaturated as e:
+                rec["shed"] = "saturated"
+                rec["retry_after"] = e.retry_after
+            recs.append(rec)
+        for rec in recs:
+            if rec["shed"] is None:
+                try:
+                    rec["req"].wait(timeout=600)
+                except Exception as e:
+                    rec["shed"] = f"error: {e!r}"
+
+        # --- analysis + gates ---
+        def pct_block(rs):
+            ttft = sorted(r["first"] - r["t_sub"] for r in rs
+                          if r["first"] is not None)
+            tpot = sorted((r["last"] - r["first"]) / (r["n"] - 1)
+                          for r in rs
+                          if r["first"] is not None and r["n"] > 1)
+            e2e = sorted(r["last"] - r["t_sub"] for r in rs
+                         if r["last"] is not None)
+            return {
+                "requests": len(rs),
+                "completed": sum(1 for r in rs if r["shed"] is None),
+                "shed": sum(1 for r in rs if r["shed"] is not None),
+                "ttft_p50_ms": _pct_ms(ttft, 0.50),
+                "ttft_p95_ms": _pct_ms(ttft, 0.95),
+                "ttft_p99_ms": _pct_ms(ttft, 0.99),
+                "tpot_p50_ms": _pct_ms(tpot, 0.50),
+                "tpot_p95_ms": _pct_ms(tpot, 0.95),
+                "tpot_p99_ms": _pct_ms(tpot, 0.99),
+                "e2e_p95_ms": _pct_ms(e2e, 0.95),
+            }
+
+        per_tenant = {}
+        for tenant in (*weights, "capped"):
+            per_tenant[tenant] = {
+                klass: pct_block([r for r in recs if r["tenant"] == tenant
+                                  and r["class"] == klass])
+                for klass in ("interactive", "batch")
+                if any(r["tenant"] == tenant and r["class"] == klass
+                       for r in recs)}
+        inter = [r for r in recs if r["class"] == "interactive"]
+        batch = [r for r in recs if r["class"] == "batch"]
+        inter_failed = [r for r in recs if r["class"] == "interactive"
+                        and r["shed"] is not None]
+        batch_shed = [r for r in batch if r["shed"] == "saturated"]
+        quota_shed = [r for r in recs if r["shed"] == "quota"]
+        delivered = {t: sum(r["n"] for r in batch if r["tenant"] == t
+                            and r["shed"] is None) for t in weights}
+        total_delivered = max(sum(delivered.values()), 1)
+        total_w = sum(weights.values())
+        shares = {t: delivered[t] / total_delivered for t in weights}
+        share_err = {t: abs(shares[t] - weights[t] / total_w)
+                     for t in weights}
+        inter_ttft = sorted(r["first"] - r["t_sub"] for r in inter
+                            if r["first"] is not None)
+        inter_p95 = _pct(inter_ttft, 0.95)
+        # admission-latency bound (docs/SERVING.md): an interactive arrival
+        # waits out at most the in-flight dispatch pair (pipelined depth 2)
+        # before preemption/class-priority get it a slot. The largest
+        # single dispatch is either a K-step super-step (K*B tokens) or a
+        # max-chunk prefill (PREFILL_CHUNKS[0] positions, with riders), so
+        # the window is 2*(chunk + K*B)/capacity wall seconds. On
+        # accelerators that is milliseconds and the gate tends to pure
+        # 1.5x; on a 2-core CI box the dispatch window dominates a ~50 ms
+        # uncontended TTFT, so the gate adds it (plus 30 ms timer noise) as
+        # the absolute floor — a multi-second queueing pathology (e.g. the
+        # cold-compile stall this bench caught during development) still
+        # fails by an order of magnitude.
+        from distributed_llama_tpu.runtime.engine import PREFILL_CHUNKS
+
+        adm_window = (2.0 * (PREFILL_CHUNKS[0] + K * B)
+                      / max(cap_tok_s, 1e-9))
+        ttft_gate = (unc_p95 is not None and inter_p95 is not None
+                     and inter_p95 <= max(1.5 * unc_p95,
+                                          unc_p95 + adm_window + 0.030))
+        gates = {
+            "zero_failed_interactive": not inter_failed,
+            "interactive_ttft_within_1_5x": bool(ttft_gate),
+            "batch_sheds_honest": bool(batch_shed) and all(
+                r["retry_after"] and 0.0 < r["retry_after"] <= 60.0
+                for r in batch_shed),
+            "quota_throttles_honest": bool(quota_shed) and all(
+                r["retry_after"] and r["retry_after"] > 0.0
+                for r in quota_shed),
+            "shares_within_eps": all(e <= 0.12 for e in share_err.values()),
+        }
+        out = {
+            "metric": "trace_interactive_ttft_p95_ms",
+            "value": round(inter_p95 * 1e3, 2) if inter_p95 else None,
+            "unit": "ms", "vs_baseline": None,
+            "uncontended_ttft_p95_ms": round(unc_p95 * 1e3, 2)
+            if unc_p95 else None,
+            "ttft_ratio": round(inter_p95 / unc_p95, 3)
+            if inter_p95 and unc_p95 else None,
+            "admission_window_ms": round(adm_window * 1e3, 2),
+            "capacity_tok_s": round(cap_tok_s, 1),
+            "overload": args.overload,
+            "duration_s": round(duration, 2),
+            "arrivals": len(recs),
+            "interactive_requests": len(inter),
+            "interactive_failed": len(inter_failed),
+            "batch_shed": len(batch_shed),
+            "quota_throttled": len(quota_shed),
+            "retry_after_p50_s": _pct(sorted(
+                r["retry_after"] for r in batch_shed
+                if r["retry_after"] is not None), 0.5),
+            "tenant_shares": {t: round(s, 3) for t, s in shares.items()},
+            "tenant_share_target": {t: round(w / total_w, 3)
+                                    for t, w in weights.items()},
+            "tenant_share_err": {t: round(e, 3)
+                                 for t, e in share_err.items()},
+            "per_tenant": per_tenant,
+            "gates": gates,
+            "batch": B, "superstep": K,
+        }
+        print(json.dumps(out))
+        if args.latency_log:
+            write_latency_log(args.latency_log, [
+                {"request_id": (r.get("req").rid if r.get("req") is not None
+                                else None),
+                 "tenant": r["tenant"], "class": r["class"],
+                 "ttft_s": (r["first"] - r["t_sub"])
+                 if r["first"] is not None else None,
+                 "e2e_s": (r["last"] - r["t_sub"])
+                 if r["last"] is not None else None,
+                 "tokens": r["n"], "replica": None, "shed": r["shed"],
+                 "retry_after_s": r["retry_after"]} for r in recs])
+        if not all(gates.values()):
+            print(f"❌ SLO gates failed: "
+                  f"{[k for k, v in gates.items() if not v]}",
+                  file=sys.stderr)
+            sys.exit(1)
+    finally:
+        be.close()
+
+
 def vs_baseline(args, tok_s: float):
     """Ratio vs the reference's published number — which exists only for the
     Llama-2-7B single-node config (README.md:131). Other archs report null rather
@@ -1461,7 +1761,8 @@ def main():
                     help="bench chunked prefill throughput at chunk size T instead "
                          "of decode")
     ap.add_argument("--workload",
-                    choices=("shared-prefix", "chaos", "repetition"),
+                    choices=("shared-prefix", "chaos", "repetition",
+                             "trace"),
                     default=None,
                     help="scenario mode: 'shared-prefix' drives the BatchEngine "
                          "with a common-system-prompt multi-request workload and "
@@ -1473,7 +1774,19 @@ def main():
                          "n-gram-dense (code/JSON-shaped) prompts through the "
                          "batched scheduler spec-off vs --speculative K and "
                          "reports tok/s both ways + accept rate "
-                         "(docs/SERVING.md \"Speculative decoding\")")
+                         "(docs/SERVING.md \"Speculative decoding\"); "
+                         "'trace' drives the multi-tenant scheduler at "
+                         "--overload x measured capacity with seeded bursty "
+                         "arrivals, heavy-tailed lengths, and a weighted "
+                         "tenant mix, gating the SLO story in-run "
+                         "(docs/SERVING.md \"Multi-tenant serving\")")
+    ap.add_argument("--overload", type=float, default=2.0, metavar="X",
+                    help="trace workload: offered batch load as a multiple "
+                         "of the engine's measured sustained capacity")
+    ap.add_argument("--duration", type=float, default=10.0, metavar="S",
+                    help="trace workload: overload-phase length (arrivals "
+                         "capped at 1500; the cap shortens the phase, "
+                         "never thins the rate)")
     ap.add_argument("--speculative", type=int, default=0, metavar="S",
                     help="batched speculative decoding (--batch / --workload "
                          "repetition): draft up to S tokens per row from the "
@@ -1732,6 +2045,14 @@ def main():
             # pass --small/--arch to force a specific shape instead
             spec = ModelSpec(**TINY_REP).resolved()
         repetition_workload(args, spec)
+        return
+    if args.workload == "trace":
+        if not on_tpu and not args.small and args.arch == "llama2_7b":
+            # same CPU default as repetition: the trace bench measures
+            # SCHEDULING policy, which the tiny geometry exercises at
+            # realistic queue depths in seconds instead of minutes
+            spec = ModelSpec(**TINY_REP).resolved()
+        trace_workload(args, spec)
         return
     if args.batch > 0 and args.pipeline is not None:
         batched_engine_bench(args, spec)

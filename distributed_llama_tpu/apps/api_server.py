@@ -31,7 +31,10 @@ from ..obs.process import install_process_metrics
 from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
                                  EngineDraining, EngineSaturated,
-                                 EngineWedged, InvalidRequest, retriable)
+                                 EngineWedged, InvalidRequest, QuotaExceeded,
+                                 retriable)
+from ..resilience.tenancy import (CLASSES, DEFAULT_TENANT, TenantRegistry,
+                                  sanitize_tenant)
 from ..resilience.quiet_http import QuietServer
 from ..runtime.engine import Engine
 from ..runtime.sampler import Sampler
@@ -76,6 +79,17 @@ _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
                  "/v1/stats", "/metrics", "/health", "/healthz",
                  "/v1/requests", "/v1/trace")
 
+def _class_from(body: dict) -> str:
+    """Scheduling class from the body's `"class"` field (an X-Class header
+    is folded into the body by do_POST before this runs; body wins).
+    Unlabeled traffic is interactive — the safe default for
+    latency-sensitive clients; garbage is a 400, never a silent guess."""
+    raw = str(body.get("class") or "interactive").strip().lower()
+    if raw not in CLASSES:
+        raise InvalidRequest(
+            f"'class' must be one of {CLASSES}, got {raw!r}")
+    return raw
+
 
 def _count_http(path: str, code: int) -> None:
     # unknown paths collapse to one label value so scrapes stay bounded;
@@ -106,8 +120,14 @@ class ApiState:
                  batch_engine=None, speculative_k: int = 0,
                  prefix_cache=True, prefix_cache_blocks: int = 0,
                  prefix_block_tokens: int = 16, prefix_cache_q80: bool = False,
-                 request_deadline: float = 0.0):
+                 request_deadline: float = 0.0,
+                 tenants: TenantRegistry | None = None):
         self.engine = engine
+        # multi-tenant policy (docs/SERVING.md "Multi-tenant serving"): the
+        # registry the X-Tenant mapping resolves against. With a batch
+        # engine the SAME object is the engine's quota/fairness authority
+        # (enforced at submit); the --batch 1 path enforces the quota here.
+        self.tenants = tenants
         # replica identity (docs/FLEET.md): set to host:port once the server
         # socket binds (serve()); what the router's membership poller reads
         self.replica_id = ""
@@ -221,6 +241,8 @@ def _stats_payload(state: "ApiState") -> dict:
                  "metrics": metrics.snapshot()}
     if state.supervisor is not None:
         out["supervisor"] = state.supervisor.stats()
+    if state.tenants is not None:
+        out["tenants"] = state.tenants.stats()
     be = state.batch_engine
     pc = (be.prefix_cache if be is not None
           else state.cache.cache if state.cache is not None else None)
@@ -322,11 +344,17 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
     if state.draining:
         raise EngineDraining("server is draining (shutting down)")
     rc = reqctx.current()
+    # multi-tenant identity (docs/SERVING.md "Multi-tenant serving"): the
+    # tenant rode in on the bound trace context (do_POST's X-Tenant
+    # mapping); the class is a request option. Both raise 400 on garbage.
+    tenant = (rc.tenant if rc is not None and rc.tenant else DEFAULT_TENANT)
+    klass = _class_from(body)
     if rc is not None:
         # open the flight-recorder timeline at the HTTP boundary (the
         # BatchEngine enriches the same record from the scheduler side)
         flight.start(rc.request_id, rc.trace_id, replica=state.replica_id,
-                     stream=bool(body.get("stream", False)))
+                     stream=bool(body.get("stream", False)),
+                     **{"tenant": tenant, "class": klass})
     t_start = time.perf_counter()
     ttft: list = [None]
     user_emit = emit
@@ -395,6 +423,12 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
     # — a resumed request must never outlive the deadline the client set
     deadlines = [d for d in (state.request_deadline, deadline_s) if d]
     eff_deadline = min(deadlines) if deadlines else 0.0
+    if state.batch_engine is None and state.tenants is not None:
+        # --batch 1 (no scheduler to enforce policy): debit the tenant's
+        # quota here — QuotaExceeded maps to 429 + Retry-After. The batched
+        # path leaves enforcement to BatchEngine.submit (same registry
+        # object; charging at both layers would double-bill every request).
+        state.tenants.acquire(tenant, float(len(prompt) + max(max_tokens, 1)))
 
     stops = tok.chat_stops()
     stop_param = _opt(body, "stop", [])
@@ -452,7 +486,7 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
                 prompt + resume, max_tokens, sampler, on_token=on_token,
                 stop_check=qstreamer.stop_check,
                 deadline=eff_deadline or None,
-                resume_tokens=len(resume))
+                resume_tokens=len(resume), tenant=tenant, klass=klass)
             # sentinel closes the drain loop the moment the request completes
             # (the puts happen-before done.set(), so everything queued is
             # drained first)
@@ -582,7 +616,8 @@ def _flight_error(rid: str, e: Exception) -> None:
     finishing each one would flood --slow-log and churn every real
     timeline out of the ring exactly when the recorder matters most.
     Server-side failures (500s, deadline expiries) stay exemplars."""
-    if isinstance(e, (EngineSaturated, EngineClosed, ValueError)):
+    if isinstance(e, (EngineSaturated, EngineClosed, QuotaExceeded,
+                      ValueError)):
         flight.drop(rid)
     else:
         flight.finish(rid, None, error=str(e))
@@ -594,6 +629,10 @@ def _map_error(e: Exception) -> tuple[int, str, float | None]:
     InvalidRequest subclasses ValueError, so the isinstance order matters:
     the specific mappings come first and a bare ValueError (template/encode
     failures on caller input) stays a 400."""
+    if isinstance(e, QuotaExceeded):
+        # the tenant's own token bucket, not server load: 429, and the
+        # Retry-After comes from the bucket's refill arithmetic
+        return 429, "rate_limit_error", getattr(e, "retry_after", 1.0)
     if isinstance(e, EngineSaturated):
         return 503, "overloaded_error", getattr(e, "retry_after", 1.0)
     if isinstance(e, EngineWedged):
@@ -737,13 +776,15 @@ class Handler(BaseHTTPRequestHandler):
             else:
                 self._json(200, r)
             return
+        qs = parse_qs(parts.query)
         try:
-            slowest = int(parse_qs(parts.query).get("slowest", ["0"])[0])
+            slowest = int(qs.get("slowest", ["0"])[0])
         except ValueError:
             self._error(400, "'slowest' must be an integer",
                         "invalid_request_error")
             return
-        self._json(200, rec.requests(slowest=slowest))
+        tenant = qs.get("tenant", [None])[0]  # per-tenant filter
+        self._json(200, rec.requests(slowest=slowest, tenant=tenant))
 
     def do_POST(self):
         if self.path not in ("/v1/chat/completions", "/chat/completions"):
@@ -793,7 +834,15 @@ class Handler(BaseHTTPRequestHandler):
         # proxied hop; any W3C-speaking client works too) or originate a
         # trace here; the completion id doubles as the flight-recorder key
         rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
-        ctx = reqctx.adopt(self.headers.get("traceparent"), request_id=rid)
+        # tenant identity (docs/SERVING.md "Multi-tenant serving"): the
+        # X-Tenant header (relayed by the fleet router on every proxy try
+        # and durable resume) rides the request context into the engine's
+        # quota/fairness accounting and the flight-recorder timeline; an
+        # X-Class header composes with the body's "class" field (body wins)
+        ctx = reqctx.adopt(self.headers.get("traceparent"), request_id=rid,
+                           tenant=sanitize_tenant(self.headers.get("X-Tenant")))
+        if "class" not in body and self.headers.get("X-Class"):
+            body["class"] = self.headers.get("X-Class")
         # batched mode: the scheduler serializes device access itself, so concurrent
         # requests proceed without the server-side lock (they share decode steps)
         import contextlib
@@ -894,7 +943,8 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           slow_log: str | None = None,
           slow_threshold: float = 1.0,
           supervisor_threshold: float = 0.0,
-          supervisor_poll: float = 1.0) -> ThreadingHTTPServer:
+          supervisor_poll: float = 1.0,
+          tenants: TenantRegistry | None = None) -> ThreadingHTTPServer:
     # batched speculative decoding lives in the BatchEngine scheduler
     # (construct it with speculative=K); speculative_k here drives only the
     # sequential engine's per-request verify loop. Guard EVERY caller, not
@@ -907,6 +957,11 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
             "be constructed with speculative=K (BatchEngine owns the "
             "batched draft-verify path)")
     runner = batch_engine or engine
+    # one policy authority per replica: prefer the batch engine's own
+    # registry (quota enforced at submit) so the HTTP mapping and the
+    # scheduler agree on every tenant's weight and bucket
+    if tenants is None and batch_engine is not None:
+        tenants = getattr(batch_engine, "tenants", None)
     state = ApiState(engine, template_type,
                      default_sampler or Sampler(runner.spec.vocab_size, 0.7, 0.9, 0),
                      device_loop_chunk, batch_engine=batch_engine,
@@ -914,7 +969,7 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
                      prefix_cache_blocks=prefix_cache_blocks,
                      prefix_block_tokens=prefix_block_tokens,
                      prefix_cache_q80=prefix_cache_q80,
-                     request_deadline=request_deadline)
+                     request_deadline=request_deadline, tenants=tenants)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = QuietServer((host, port), handler)
     server.api_state = state  # drain controller / tests reach the state here
@@ -1084,11 +1139,39 @@ def main(argv=None) -> None:
     p.add_argument("--supervisor-poll", type=float, default=1.0, metavar="S",
                    help="supervisor watchdog sampling period (detection "
                         "latency is threshold + poll)")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant policy (docs/SERVING.md \"Multi-tenant"
+                        " serving\"): ';'-separated "
+                        "name[:weight=W,rate=R,burst=B] entries — W drives "
+                        "weighted-fair scheduling, R/B a token-bucket quota "
+                        "in tokens/sec (429 + Retry-After on exhaustion; "
+                        "0/absent = unlimited). Requests pick their tenant "
+                        "via the X-Tenant header; unknown ids share the "
+                        "'default' entry. Example: "
+                        "'gold:weight=4;free:weight=1,rate=50,burst=100'")
+    p.add_argument("--slo-ttft-interactive", type=float, default=0.0,
+                   metavar="S",
+                   help="SLO-aware shedding (--batch > 1): refuse an "
+                        "interactive admission when the measured queue "
+                        "drain rate projects its wait past S seconds — "
+                        "after first evicting queued batch-class work "
+                        "(batch sheds before interactive); 0 = off")
+    p.add_argument("--slo-ttft-batch", type=float, default=0.0, metavar="S",
+                   help="batch-class TTFT target: refuse batch admissions "
+                        "whose projected queue wait exceeds S seconds "
+                        "(503 + drain-derived Retry-After); 0 = off")
+    p.add_argument("--slo-tpot", type=float, default=0.0, metavar="S",
+                   help="interactive TPOT target in seconds/token: while "
+                        "the measured decode pace exceeds it, new "
+                        "batch-class admissions are refused (they would "
+                        "widen every shared dispatch further); 0 = off")
     args = p.parse_args(argv)
     from .dllama import dump_trace, install_trace
 
     install_trace(args)
     faults.install_from_env()  # DLLAMA_FAULTS chaos config (resilience/)
+    # tenant policy is operator configuration: parse failures abort startup
+    tenants = TenantRegistry.parse(args.tenants) if args.tenants else None
     batch_engine = None
     if args.dp > 1 and args.batch <= 1:
         p.error("--dp requires --batch > 1 (data parallelism shards batched cache rows)")
@@ -1119,6 +1202,10 @@ def main(argv=None) -> None:
             prefix_block_tokens=args.prefix_cache_block_tokens,
             prefix_cache_q80=args.prefix_cache_q80,
             max_queue=args.max_queue, queue_ttl=args.queue_ttl,
+            tenants=tenants,
+            slo_ttft_interactive=args.slo_ttft_interactive,
+            slo_ttft_batch=args.slo_ttft_batch,
+            slo_tpot_interactive=args.slo_tpot,
             tp=args.tp, dp=args.dp, pod=args.pod,
             cache_write=args.cache_write, moe_sharding=args.moe_sharding,
             fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
@@ -1152,7 +1239,8 @@ def main(argv=None) -> None:
                    slow_log=args.slow_log,
                    slow_threshold=args.slow_threshold,
                    supervisor_threshold=args.supervisor_threshold,
-                   supervisor_poll=args.supervisor_poll)
+                   supervisor_poll=args.supervisor_poll,
+                   tenants=tenants)
     # SIGTERM -> graceful drain (docs/ROBUSTNESS.md): /healthz flips to
     # draining, admissions stop, in-flight requests finish, then shutdown
     install_sigterm_drain(server, server.api_state, args.drain_timeout)

@@ -60,6 +60,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "SSE error events")
     p.add_argument("--proxy-timeout", type=float, default=120.0, metavar="S",
                    help="per-try socket timeout (connect and each read)")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="router-level multi-tenant policy (docs/SERVING.md "
+                        "\"Multi-tenant serving\"): ';'-separated "
+                        "name[:weight=W,rate=R,burst=B] entries — R/B a "
+                        "token-bucket quota (429 + Retry-After before any "
+                        "proxy work), W the fair-share weight the "
+                        "--max-inflight gate uses. Tenants are picked via "
+                        "the X-Tenant header and relayed to replicas on "
+                        "every try and durable resume")
+    p.add_argument("--max-inflight", type=int, default=0, metavar="N",
+                   help="bound concurrent completion proxies fleet-wide; "
+                        "contended capacity is granted in weighted-fair "
+                        "order (interactive class first, tenants by "
+                        "weight) instead of thread-wakeup order (0 = "
+                        "unbounded, the pre-tenancy behavior)")
+    p.add_argument("--gate-timeout", type=float, default=30.0, metavar="S",
+                   help="how long a request may wait in the --max-inflight "
+                        "fair gate before shedding with 503 + "
+                        "drain-derived Retry-After")
     p.add_argument("--seed", type=int, default=0,
                    help="random-routing RNG seed (A/B reproducibility)")
     p.add_argument("--trace", default=None, metavar="OUT.json",
@@ -84,7 +103,8 @@ def main(argv=None) -> None:
         poll_interval=args.poll_interval, poll_timeout=args.poll_timeout,
         block_bytes=args.block_bytes, affinity_nodes=args.affinity_nodes,
         retries=args.retries, try_timeout=args.proxy_timeout, seed=args.seed,
-        durable=not args.no_durable)
+        durable=not args.no_durable, tenants=args.tenants,
+        max_inflight=args.max_inflight, gate_timeout=args.gate_timeout)
 
     def _on_term(signum, frame):
         # the router holds no request state worth draining beyond in-flight

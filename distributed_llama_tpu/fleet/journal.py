@@ -74,6 +74,11 @@ class JournalEntry:
     body: dict                    # seed-pinned request (WITHOUT resume field)
     stream: bool                  # client asked for SSE
     deadline_ms: float | None     # original X-Deadline-Ms budget, if any
+    # multi-tenant identity (docs/SERVING.md "Multi-tenant serving"):
+    # re-stamped as X-Tenant/X-Class on every upstream try INCLUDING
+    # mid-stream resumes, so failover preserves tenant accounting
+    tenant: str = ""
+    klass: str = ""
     t0: float = field(default_factory=time.perf_counter)
     tokens: list[int] = field(default_factory=list)  # delivered token ids
     sent_chars: int = 0           # content chars relayed to the client
@@ -141,8 +146,8 @@ class RequestJournal:
         self._lock = threading.Lock()  # guards: _live, _seq
         self._seq = 0
 
-    def open(self, body: dict, stream: bool,
-             deadline_ms: float | None) -> JournalEntry | None:
+    def open(self, body: dict, stream: bool, deadline_ms: float | None,
+             tenant: str = "", klass: str = "") -> JournalEntry | None:
         """Journal a new request (seed pinned here). None when the table is
         full — the caller should fall back to the non-durable proxy path
         rather than shed (an unjournaled request is still served, it just
@@ -152,7 +157,8 @@ class RequestJournal:
                 return None
             self._seq += 1
             rid = f"jrn-{self._seq:08d}"
-            entry = JournalEntry(rid, pin_seed(body), stream, deadline_ms)
+            entry = JournalEntry(rid, pin_seed(body), stream, deadline_ms,
+                                 tenant=tenant, klass=klass)
             self._live[rid] = entry
             _INFLIGHT.set(len(self._live))
         return entry

@@ -42,7 +42,10 @@ from urllib.parse import parse_qs
 from ..obs import metrics, reqctx, trace
 from ..obs.process import install_process_metrics
 from ..resilience import faults
+from ..resilience.errors import QuotaExceeded
 from ..resilience.quiet_http import QuietServer
+from ..resilience.tenancy import (DrainRate, FairGate, TenantRegistry,
+                                  sanitize_tenant)
 from .affinity import AffinityMap
 from .journal import RequestJournal, iter_sse_data, parse_chunk
 from .membership import Membership, Replica
@@ -71,6 +74,24 @@ _SCRAPE_ERRORS = metrics.counter(
     "Replica /metrics//v1/stats fetches that failed during aggregation")
 _PROXY_SECONDS = metrics.histogram(
     "router_proxy_seconds", "Per-try proxy wall time (successful tries)")
+# Multi-tenant policy at the fleet edge (docs/SERVING.md "Multi-tenant
+# serving"): router-level quota throttles and fairness-gate sheds. Labels
+# stay bounded — unknown tenant ids collapse to the canonical "default".
+_THROTTLED = metrics.counter(
+    "router_throttled_total",
+    "Requests refused with 429: the tenant's router-level token bucket "
+    "was exhausted", labelnames=("tenant",))
+_GATE_SHED = metrics.counter(
+    "router_gate_shed_total",
+    "Requests shed because the weighted-fair inflight gate "
+    "(--max-inflight) stayed full past the gate timeout")
+_GATE_WAITING = metrics.gauge(
+    "router_gate_waiting",
+    "Handler threads currently parked in the weighted-fair inflight gate")
+_DRAIN_RATE = metrics.gauge(
+    "router_drain_rate",
+    "Measured fleet completions/sec through this router (decayed EMA) — "
+    "the denominator of the router's drain-derived Retry-After hints")
 
 _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
                  "/v1/stats", "/metrics", "/health", "/healthz", "/v1/trace",
@@ -83,9 +104,23 @@ class RouterState:
                  retries: int = 2, try_timeout: float = 120.0,
                  scrape_timeout: float = 3.0, key_bytes: int = 4096,
                  seed: int = 0, durable: bool = True,
-                 journal_inflight: int = 4096):
+                 journal_inflight: int = 4096,
+                 tenants: TenantRegistry | None = None,
+                 max_inflight: int = 0, gate_timeout: float = 30.0):
         assert policy in ("affinity", "random"), policy
         self.membership = membership
+        # Multi-tenant fleet edge (docs/SERVING.md "Multi-tenant serving"):
+        # optional router-level token-bucket quotas (429 before any proxy
+        # work) and a weighted-fair inflight gate replacing the implicit
+        # FIFO of handler-thread scheduling — when `max_inflight` > 0,
+        # concurrent completion proxies are bounded and contended capacity
+        # is handed out interactive-first, tenants by weight. The drain
+        # estimator feeds every fleet-saturation Retry-After hint (measured
+        # completions/sec vs depth, never the poll-interval constant).
+        self.tenants = tenants
+        self.gate = FairGate(max_inflight, tenants)
+        self.gate_timeout = gate_timeout
+        self.drain = DrainRate()
         self.affinity = AffinityMap(block_bytes=block_bytes,
                                     max_nodes=affinity_nodes)
         self.policy = policy
@@ -151,6 +186,23 @@ class RouterState:
             pick = ties[self._rr % len(ties)]
             self._rr += 1
         return pick, "least_loaded"
+
+    def note_done(self) -> None:
+        """One completion fully relayed: feed the drain estimator (the
+        denominator of every fleet-saturation Retry-After hint)."""
+        self.drain.note()
+        _DRAIN_RATE.set(self.drain.rate())
+
+    def retry_after_hint(self) -> float:
+        """Drain-derived Retry-After for fleet-saturation refusals: the
+        measured time for the fleet to work off its current backlog
+        (polled queue depth + router in-flight across replicas, plus gate
+        waiters), floored and capped (resilience/tenancy.py DrainRate) —
+        the header tracks load instead of relaying the membership
+        poll-interval constant."""
+        depth = sum(r.queue_depth + r.inflight
+                    for r in self.membership.replicas) + self.gate.waiting()
+        return self.drain.retry_after(depth + 1)
 
 
 # ----------------------------------------------------------------------
@@ -446,7 +498,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             rep = state.membership.least_loaded()
             if rep is None:
                 self._error(503, "no healthy replica", "overloaded_error",
-                            retry_after=state.membership.poll_interval)
+                            retry_after=state.retry_after_hint())
                 return
             try:
                 status, body = _fetch(rep, self.path, state.try_timeout)
@@ -496,12 +548,64 @@ class RouterHandler(BaseHTTPRequestHandler):
             self._error(400, "X-Deadline-Ms must be a number (ms)",
                         "invalid_request_error")
             return
+        # multi-tenant fleet edge (docs/SERVING.md "Multi-tenant serving"):
+        # resolve the tenant/class once; the router-level quota refuses
+        # with 429 BEFORE any proxy work, and the X-Tenant/X-Class headers
+        # are relayed on every try (and durable resume) so replica-side
+        # accounting survives failover
+        tenant = sanitize_tenant(self.headers.get("X-Tenant"))
+        klass = str(body.get("class") or self.headers.get("X-Class")
+                    or "interactive").strip().lower()
+        if klass not in ("interactive", "batch"):
+            klass = "interactive"
+        tenant_hdrs = {"X-Tenant": tenant, "X-Class": klass}
+        cost = 0.0
+        if state.tenants is not None:
+            # router-level cost estimate: the router never tokenizes, so
+            # charge ~chars/4 of rendered content plus the decode budget
+            chars = sum(len(str(m.get("content", "")))
+                        for m in body.get("messages", [])
+                        if isinstance(m, dict))
+            cost = chars / 4.0 + float(body.get("max_tokens") or 64)
+            try:
+                state.tenants.acquire(tenant, cost)
+            except QuotaExceeded as e:
+                _THROTTLED.labels(
+                    tenant=state.tenants.canonical(tenant)).inc()
+                self._error(429, str(e), "rate_limit_error",
+                            retry_after=e.retry_after)
+                return
+        # weighted-fair inflight gate (--max-inflight): contended capacity
+        # is handed out interactive-first, tenants by weight — a flooding
+        # tenant's handler threads can no longer take every slot
+        if not state.gate.acquire(tenant, klass,
+                                  timeout=state.gate_timeout):
+            if state.tenants is not None:
+                # zero service rendered: a gate shed must not also drain
+                # the tenant's bucket (the retry would be double-punished)
+                state.tenants.refund(tenant, cost)
+            _GATE_SHED.inc()
+            self._error(503, "fleet at --max-inflight and the fair gate "
+                        "timed out", "overloaded_error",
+                        retry_after=state.retry_after_hint())
+            return
+        _GATE_WAITING.set(state.gate.waiting())
+        try:
+            self._post_completion(body, raw, deadline_ms, tenant_hdrs)
+        finally:
+            state.gate.release()
+            _GATE_WAITING.set(state.gate.waiting())
+
+    def _post_completion(self, body: dict, raw: bytes, deadline_ms,
+                         tenant_hdrs: dict) -> None:
+        state = self.state
         # trace origination (docs/OBSERVABILITY.md "Request tracing"): adopt
         # the client's W3C traceparent or start a new trace; every proxy try
         # is its own hop (fresh span id, same trace id) stamped onto the
         # upstream request, so the replica's engine spans and this router's
         # proxy span share one trace id in the merged fleet trace
-        ctx = reqctx.adopt(self.headers.get("traceparent"))
+        ctx = reqctx.adopt(self.headers.get("traceparent"),
+                           tenant=tenant_hdrs.get("X-Tenant", ""))
         if state.durable and "resume" not in body:
             # durable path (docs/FLEET.md "Resume protocol"): journal the
             # request and survive mid-stream replica failures by resuming on
@@ -512,13 +616,16 @@ class RouterHandler(BaseHTTPRequestHandler):
             # just not failover-protected.
             entry = state.journal.open(
                 body, stream=bool(body.get("stream", False)),
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms,
+                tenant=tenant_hdrs.get("X-Tenant", ""),
+                klass=tenant_hdrs.get("X-Class", ""))
             if entry is not None:
                 self._durable_post(entry, ctx)
                 return
-        self._plain_post(body, raw, ctx, deadline_ms)
+        self._plain_post(body, raw, ctx, deadline_ms, tenant_hdrs)
 
-    def _plain_post(self, body: dict, raw: bytes, ctx, deadline_ms):
+    def _plain_post(self, body: dict, raw: bytes, ctx, deadline_ms,
+                    tenant_hdrs: dict | None = None):
         """The pre-durable proxy loop: verbatim pass-through, pre-first-byte
         failover only, mid-stream failures surfaced honestly."""
         state = self.state
@@ -527,7 +634,7 @@ class RouterHandler(BaseHTTPRequestHandler):
         tried: set[str] = set()
         last_503: tuple[bytes, str, str | None] | None = None
         for attempt in range(1 + state.retries):
-            extra = None
+            extra = dict(tenant_hdrs) if tenant_hdrs else None
             if deadline_ms is not None:
                 # propagate the REMAINING budget, not the original: a retry
                 # that re-sent the full deadline would let the fleet spend
@@ -557,9 +664,10 @@ class RouterHandler(BaseHTTPRequestHandler):
                 last_503 = info
         # every candidate exhausted (or rotation empty): fleet-level shed.
         # A replica's own 503 body is the most honest thing to relay; either
-        # way the client ALWAYS gets Retry-After so it backs off instead of
-        # hammering a saturated fleet (docs/FLEET.md).
-        retry_after = state.membership.poll_interval
+        # way the client ALWAYS gets Retry-After — derived from the
+        # MEASURED fleet drain rate vs backlog (retry_after_hint), not a
+        # constant — so it backs off in proportion to real load.
+        retry_after = state.retry_after_hint()
         if last_503 is not None:
             data, ctype, ra = last_503
             self._raw(503, ctype, data,
@@ -638,9 +746,10 @@ class RouterHandler(BaseHTTPRequestHandler):
                 tried = {rep.id}
             else:
                 fruitless += 1
-        # candidates exhausted with no completion: surface honestly
+        # candidates exhausted with no completion: surface honestly, with
+        # the drain-derived backoff hint (docs/SERVING.md)
         state.journal.close(entry, "failed")
-        retry_after = state.membership.poll_interval
+        retry_after = state.retry_after_hint()
         if client_started[0]:
             self._sse_error_event(
                 f"no replica could resume the stream ({len(tried)} tried)",
@@ -677,6 +786,13 @@ class RouterHandler(BaseHTTPRequestHandler):
                 headers = {"Content-Type": "application/json",
                            "X-Dllama-Journal": "1",
                            "traceparent": hop.to_traceparent()}
+                # tenant identity survives failover: every try (first AND
+                # resume) re-stamps the journaled tenant/class so the new
+                # replica's quota/fairness accounting stays attributed
+                if entry.tenant:
+                    headers["X-Tenant"] = entry.tenant
+                if entry.klass:
+                    headers["X-Class"] = entry.klass
                 rem = entry.remaining_deadline_ms()
                 if rem is not None:
                     headers["X-Deadline-Ms"] = str(max(int(rem), 1))
@@ -837,6 +953,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                     "finish_reason": entry.finish or "stop",
                 }],
             }, extra or None)
+        self.state.note_done()  # feeds the drain-derived Retry-After
         return "done"
 
     def _durable_start_stream(self, entry, resp, client_started: list):
@@ -872,7 +989,9 @@ class RouterHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ proxy
 
-    _RELAY_HEADERS = ("X-Request-Id", "X-Replica")
+    # Retry-After rides along so a replica's own 429 (tenant quota) and
+    # other backoff-bearing statuses keep their hint through the proxy
+    _RELAY_HEADERS = ("X-Request-Id", "X-Replica", "Retry-After")
 
     def _proxy_try(self, rep: Replica, raw: bytes, key: bytes, hop=None,
                    extra_headers: dict | None = None):
@@ -943,6 +1062,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             self._raw(resp.status, ctype, data, extra or None)
             if resp.status == 200:
                 _PROXY_SECONDS.observe(time.perf_counter() - t0)
+                state.note_done()  # feeds the drain-derived Retry-After
             return "delivered", None
         finally:
             if conn is not None:
@@ -996,6 +1116,7 @@ class RouterHandler(BaseHTTPRequestHandler):
         state.affinity.record(key, rep.id)
         self._write_chunk(b"")  # terminate the chunked response
         _PROXY_SECONDS.observe(time.perf_counter() - t0)
+        state.note_done()  # feeds the drain-derived Retry-After
         return "delivered", None
 
     def _write_chunk(self, data: bytes):
@@ -1012,17 +1133,27 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
                  poll_interval: float = 2.0, poll_timeout: float = 2.0,
                  block_bytes: int = 64, affinity_nodes: int = 8192,
                  retries: int = 2, try_timeout: float = 120.0,
-                 seed: int = 0, durable: bool = True) -> ThreadingHTTPServer:
+                 seed: int = 0, durable: bool = True,
+                 tenants: "TenantRegistry | str | None" = None,
+                 max_inflight: int = 0,
+                 gate_timeout: float = 30.0) -> ThreadingHTTPServer:
     """Build + bind the router (does NOT serve_forever — caller's thread
     choice). Membership is polled once synchronously so the first request
     already has a rotation. `server.router_state` exposes the state.
     `durable=False` reverts completions to the PR-6 verbatim pass-through
-    (mid-stream failures surfaced, not resumed)."""
+    (mid-stream failures surfaced, not resumed). `tenants` (a registry or
+    the parseable spec string) enables router-level quotas; `max_inflight`
+    > 0 arms the weighted-fair inflight gate (docs/SERVING.md
+    "Multi-tenant serving")."""
+    if isinstance(tenants, str):
+        tenants = TenantRegistry.parse(tenants) if tenants else None
     membership = Membership(replicas, poll_interval=poll_interval,
                             poll_timeout=poll_timeout)
     state = RouterState(membership, policy=policy, block_bytes=block_bytes,
                         affinity_nodes=affinity_nodes, retries=retries,
-                        try_timeout=try_timeout, seed=seed, durable=durable)
+                        try_timeout=try_timeout, seed=seed, durable=durable,
+                        tenants=tenants, max_inflight=max_inflight,
+                        gate_timeout=gate_timeout)
     membership.start()
     handler = type("BoundRouterHandler", (RouterHandler,),
                    {"state": state, "protocol_version": "HTTP/1.1"})

@@ -213,14 +213,20 @@ class FlightRecorder:
         return {"id": rec["id"], "trace_id": rec["trace_id"],
                 "start_unix": rec["start_unix"], "live": live,
                 "finish": rec["finish"], "e2e_ms": rec.get("e2e_ms"),
-                "ttft_ms": rec.get("ttft_ms"), "events": len(rec["events"])}
+                "ttft_ms": rec.get("ttft_ms"), "events": len(rec["events"]),
+                "tenant": rec.get("tenant"), "class": rec.get("class")}
 
-    def requests(self, slowest: int = 0) -> dict:
+    def requests(self, slowest: int = 0, tenant: str | None = None) -> dict:
         """Summary listing; `slowest=K` returns the K worst completed
-        requests by E2E instead of recency order."""
+        requests by E2E instead of recency order; `tenant=` keeps only the
+        named tenant's records (the per-tenant debugging entry point —
+        "show me THIS tenant's recent requests" during a fairness
+        incident)."""
         with self._lock:
-            done = [self._summary(r, False) for r in self._done.values()]
-            live = [self._summary(r, True) for r in self._live.values()]
+            done = [self._summary(r, False) for r in self._done.values()
+                    if tenant is None or r.get("tenant") == tenant]
+            live = [self._summary(r, True) for r in self._live.values()
+                    if tenant is None or r.get("tenant") == tenant]
             # eviction counters snapshotted in the SAME critical section as
             # the tables: reading them after releasing the lock could pair
             # a pre-eviction listing with a post-eviction count (or a torn

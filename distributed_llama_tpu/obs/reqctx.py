@@ -59,12 +59,18 @@ class TraceContext:
     """One request's position in a distributed trace. `trace_id` is shared
     by every hop; `span_id` identifies THIS hop's work; `request_id` is the
     serving-local id (`chatcmpl-...`) the flight recorder keys on — it never
-    goes on the wire (traceparent carries only trace/span/flags)."""
+    goes on the wire (traceparent carries only trace/span/flags).
+    `tenant` is the serving-local tenant id the HTTP layer mapped from
+    `X-Tenant` (docs/SERVING.md "Multi-tenant serving") — like request_id it
+    rides the context, not the wire (the router relays the header itself),
+    so engine-side flight events and slow-log exemplars attribute work to
+    the owning tenant."""
 
     trace_id: str        # 32 lowercase hex chars (128-bit)
     span_id: str         # 16 lowercase hex chars (64-bit)
     flags: int = 1       # W3C trace-flags; 01 = sampled
     request_id: str = ""
+    tenant: str = ""
 
     def to_traceparent(self) -> str:
         return f"00-{self.trace_id}-{self.span_id}-{self.flags:02x}"
@@ -73,12 +79,12 @@ class TraceContext:
         """Same trace, fresh span id — one per proxied hop / work unit."""
         return TraceContext(self.trace_id, _rand_hex(8), self.flags,
                             self.request_id if request_id is None
-                            else request_id)
+                            else request_id, self.tenant)
 
 
-def new_context(request_id: str = "") -> TraceContext:
+def new_context(request_id: str = "", tenant: str = "") -> TraceContext:
     """Originate a trace (the fleet router's job for header-less clients)."""
-    return TraceContext(_rand_hex(16), _rand_hex(8), 1, request_id)
+    return TraceContext(_rand_hex(16), _rand_hex(8), 1, request_id, tenant)
 
 
 def parse_traceparent(header: str | None) -> TraceContext | None:
@@ -101,13 +107,21 @@ def parse_traceparent(header: str | None) -> TraceContext | None:
     return TraceContext(trace_id, span_id, int(flags, 16))
 
 
-def adopt(header: str | None, request_id: str = "") -> TraceContext:
+def adopt(header: str | None, request_id: str = "",
+          tenant: str = "") -> TraceContext:
     """Continue an inbound trace (fresh child span id) or originate one:
-    the single call a server entry point needs."""
+    the single call a server entry point needs. `tenant` stamps the
+    serving-local tenant id either way (the wire header carries only
+    trace/span/flags)."""
     parent = parse_traceparent(header)
     if parent is None:
-        return new_context(request_id)
-    return parent.child(request_id=request_id)
+        return new_context(request_id, tenant)
+    ctx = parent.child(request_id=request_id)
+    if tenant:
+        import dataclasses
+
+        ctx = dataclasses.replace(ctx, tenant=tenant)
+    return ctx
 
 
 _var: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(

@@ -7,6 +7,7 @@ HTTP layer can map to an honest status code (and tests can assert on):
 
     EngineClosed / EngineDraining  -> 503 (server going away)
     EngineSaturated                -> 503 + Retry-After (load shed)
+    QuotaExceeded                  -> 429 + Retry-After (tenant quota)
     DeadlineExceeded               -> 408 (queue TTL / generation deadline)
     InvalidRequest                 -> 400 (caller error, not server error)
     TransientDispatchError         -> retried by the scheduler, never surfaced
@@ -24,8 +25,9 @@ test intends.
 from __future__ import annotations
 
 __all__ = ["EngineClosed", "EngineDraining", "EngineSaturated",
-           "DeadlineExceeded", "InvalidRequest", "TransientDispatchError",
-           "EngineWedged", "FaultInjected", "classify", "retriable"]
+           "QuotaExceeded", "DeadlineExceeded", "InvalidRequest",
+           "TransientDispatchError", "EngineWedged", "FaultInjected",
+           "classify", "retriable"]
 
 
 class EngineClosed(RuntimeError):
@@ -39,12 +41,30 @@ class EngineDraining(EngineClosed):
 
 
 class EngineSaturated(RuntimeError):
-    """Admission refused: the submit queue is at --max-queue. Carries
-    `retry_after` (seconds, advisory) for the HTTP 503 Retry-After header."""
+    """Admission refused: the submit queue is at --max-queue, or SLO-aware
+    shedding (docs/SERVING.md "Multi-tenant serving") projected the queue
+    wait past the request class's TTFT target. Carries `retry_after`
+    (seconds, advisory — derived from the measured queue drain rate by the
+    raiser, resilience/tenancy.py DrainRate, never a hardcoded constant)
+    for the HTTP 503 Retry-After header."""
 
     def __init__(self, msg: str, retry_after: float = 1.0):
         super().__init__(msg)
         self.retry_after = retry_after
+
+
+class QuotaExceeded(RuntimeError):
+    """A tenant's token-bucket quota is exhausted (resilience/tenancy.py):
+    admission refused before any queue or slot work, HTTP 429 +
+    Retry-After. `retry_after` comes from the bucket's own refill
+    arithmetic (seconds until the debit can succeed); `tenant` is the
+    policy name for per-tenant throttle metrics. NOT retriable on another
+    replica — the quota is the tenant's, not the replica's."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0, tenant: str = ""):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.tenant = tenant
 
 
 class DeadlineExceeded(RuntimeError):
@@ -118,7 +138,8 @@ def retriable(exc: BaseException) -> bool:
     Request-scope injected faults are the one judgment call: the fault fired
     inside THIS request's own callbacks/prefill, so a blind resume could
     loop forever on a deterministic trigger — treat as NOT retriable."""
-    if isinstance(exc, (DeadlineExceeded, ValueError, EngineSaturated)):
+    if isinstance(exc, (DeadlineExceeded, ValueError, EngineSaturated,
+                        QuotaExceeded)):
         return False
     if isinstance(exc, FaultInjected):
         return exc.fault_scope == "engine"

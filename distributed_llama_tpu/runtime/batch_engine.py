@@ -74,7 +74,9 @@ from ..obs import flight, metrics, reqctx, trace
 from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
                                  EngineDraining, EngineSaturated,
-                                 EngineWedged, classify)
+                                 EngineWedged, InvalidRequest, classify)
+from ..resilience.tenancy import (CLASSES, DEFAULT_TENANT, DrainRate,
+                                  TenantRegistry, WeightedFairQueue)
 from .engine import PREFILL_CHUNKS, GenerationStats
 from .speculative import NgramIndex
 
@@ -198,6 +200,37 @@ _RESUME_TOKENS = metrics.counter(
     "batch_resume_prefix_tokens_total",
     "Delivered-elsewhere tokens carried by resume admissions (the suffix the "
     "new replica must re-prefill or reuse)")
+# Multi-tenant serving (docs/SERVING.md "Multi-tenant serving"): per-tenant
+# service accounting (labels stay bounded — unknown tenant ids collapse to
+# the canonical "default" policy), fairness preemptions, SLO-driven sheds,
+# quota throttles, and the measured drain rate every Retry-After hint is
+# derived from (resilience/tenancy.py).
+_TENANT_TOKENS = metrics.counter(
+    "batch_tenant_tokens_total",
+    "Decode tokens delivered, by canonical tenant", labelnames=("tenant",))
+_TENANT_REQUESTS = metrics.counter(
+    "batch_tenant_requests_total",
+    "Completed requests by canonical tenant and class",
+    labelnames=("tenant", "class"))
+_PREEMPTED = metrics.counter(
+    "batch_preempted_total",
+    "Batch-class rows preempted at a super-step boundary so a waiting "
+    "interactive request could take the slot (the preempted request is "
+    "re-queued and later resumes byte-identical)")
+_SLO_SHED = metrics.counter(
+    "engine_slo_shed_total",
+    "Admissions refused (or queued batch work evicted for an interactive "
+    "arrival) because the projected queue wait exceeded the class's TTFT "
+    "target or measured TPOT exceeded the interactive target, by class",
+    labelnames=("class",))
+_QUOTA_THROTTLED = metrics.counter(
+    "engine_quota_throttled_total",
+    "Admissions refused with 429: the tenant's token-bucket quota was "
+    "exhausted", labelnames=("tenant",))
+_DRAIN_RATE = metrics.gauge(
+    "engine_drain_rate",
+    "Measured request completions/sec (decayed EMA, resilience/tenancy.py "
+    "DrainRate) — the denominator of drain-derived Retry-After hints")
 # Hung-engine supervision (resilience/supervisor.py): the watchdog gauge
 # escalated to action — recoveries attempted and the requests they failed.
 _WEDGE_RECOVERIES = metrics.counter(
@@ -235,6 +268,17 @@ class BatchRequest:
 
     cancelled: bool = False
     submit_t: float = 0.0  # perf_counter at submit(), feeds batch_queue_wait
+    # multi-tenant identity (docs/SERVING.md "Multi-tenant serving"):
+    # `tenant` is the serving-local tenant id (quota + fair-share key),
+    # `klass` the scheduling class — "interactive" (strict queue priority,
+    # may preempt batch rows at super-step boundaries) or "batch" (absorbs
+    # slack, shed first under overload). `wfq_cost` is the virtual-service
+    # cost the fair queue charges (≈ total token positions the request
+    # consumes); `preemptions` counts slot losses to interactive arrivals.
+    tenant: str = DEFAULT_TENANT
+    klass: str = "interactive"
+    wfq_cost: float = 1.0
+    preemptions: int = 0
     # durable resume (docs/FLEET.md): the last `resume_tokens` entries of
     # `prompt` are generated-and-delivered-elsewhere tokens, not user prompt —
     # admission counts them separately and the sampler arrives fast-forwarded
@@ -302,6 +346,10 @@ class _Slot:
         # request's prompt + emitted tokens, appended per delivered token —
         # the per-slot proposer behind batched draft-verify super-steps
         self.ngram: NgramIndex | None = None
+        # per-tenant token counter child, resolved ONCE at admission so the
+        # per-token hot path (_emit) pays a bound-method call, not a label
+        # dict lookup
+        self.tok_counter = None
 
 
 class _InflightStep:
@@ -358,6 +406,10 @@ class BatchEngine:
                  queue_ttl: float = 0.0, max_retries: int = 3,
                  retry_backoff: float = 0.05, speculative: int = 0,
                  spec_min_draft: int = 1, spec_chain_expect: float = 2.0,
+                 tenants: TenantRegistry | None = None,
+                 slo_ttft_interactive: float = 0.0,
+                 slo_ttft_batch: float = 0.0,
+                 slo_tpot_interactive: float = 0.0,
                  **engine_kw):
         from .engine import Engine
 
@@ -389,9 +441,22 @@ class BatchEngine:
         self._gap_t: float | None = None  # last dispatch-ready time, gap metric
         self._slots = [_Slot(i) for i in range(slots)]
         self._queue: "queue.Queue[BatchRequest]" = queue.Queue()
+        # Multi-tenant policy (docs/SERVING.md "Multi-tenant serving"):
+        # `tenants` configures per-tenant quotas + fair-share weights (None
+        # = single default tenant: quotas off, weights uniform — the
+        # pre-tenancy behavior); the slo_* targets drive SLO-aware shedding
+        # at submit (0 = off); `_drain` measures completions/sec so every
+        # Retry-After hint tracks real load instead of a constant; the
+        # wait queue itself is a two-class weighted-fair queue, not a FIFO.
+        self.tenants = tenants
+        self.slo_ttft = {"interactive": max(slo_ttft_interactive, 0.0),
+                         "batch": max(slo_ttft_batch, 0.0)}
+        self.slo_tpot_interactive = max(slo_tpot_interactive, 0.0)
+        self._drain = DrainRate()
+        self._tpot_ema_ms = 0.0  # measured per-token ms (scheduler-written)
         # overflow requests with no free slot; guarded by _plock (close() may run while
         # the scheduler thread is still finishing a long device step)
-        self._pending: list[BatchRequest] = []
+        self._pending: WeightedFairQueue = WeightedFairQueue(tenants)
         self._plock = threading.Lock()  # guards: _pending
         # Batched speculative decoding (docs/SERVING.md "Speculative
         # decoding"): spec_k > 0 drafts up to k tokens per row from the
@@ -485,7 +550,8 @@ class BatchEngine:
     def submit(self, prompt: list[int], max_tokens: int, sampler,
                on_token=None, stop_check=None, *, deadline: float | None = None,
                ttl: float | None = None, rid: str | None = None,
-               ctx=None, resume_tokens: int = 0) -> BatchRequest:
+               ctx=None, resume_tokens: int = 0, tenant: str = "",
+               klass: str = "interactive") -> BatchRequest:
         """Enqueue a request. `deadline` (seconds) bounds the WHOLE request
         (queue + generation; finish reason "deadline", partial output kept);
         `ttl` bounds queue wait only (overrides the engine's queue_ttl).
@@ -498,23 +564,53 @@ class BatchEngine:
         the caller must pass the sampler already fast-forwarded past their
         coins; admission then re-prefills prompt ⊕ resume (mostly a radix
         prefix-cache hit) and generation continues byte-identical to the
-        uninterrupted run. Raises EngineDraining/EngineClosed during
-        shutdown and EngineSaturated when the wait queue is at max_queue."""
+        uninterrupted run.
+
+        `tenant`/`klass` are the multi-tenant scheduling identity
+        (docs/SERVING.md "Multi-tenant serving"): `tenant` defaults from
+        the bound trace context (the api layer's X-Tenant mapping) and
+        keys quota + fair-share accounting; `klass` is "interactive"
+        (strict priority, may preempt batch rows) or "batch" (absorbs
+        slack, shed first). Raises EngineDraining/EngineClosed during
+        shutdown, QuotaExceeded (429) when the tenant's token bucket is
+        exhausted, and EngineSaturated (503) when the wait queue is at
+        max_queue or SLO-aware shedding refuses the class — both with
+        Retry-After derived from the measured queue drain rate."""
         if self._draining and not self._shutdown:
             raise EngineDraining(
                 "BatchEngine is draining (serving in-flight requests only)")
         if self._shutdown:
             raise EngineClosed("BatchEngine is closed")
         faults.fire("batch.submit")
-        if self.max_queue:
-            with self._plock:
-                queued = len(self._pending) + self._queue.qsize()
-            if queued >= self.max_queue:
-                _SHED.inc()
-                raise EngineSaturated(
-                    f"queue depth {queued} at max_queue={self.max_queue}",
-                    retry_after=max(self.queue_ttl, 1.0))
+        if klass not in CLASSES:
+            raise InvalidRequest(
+                f"unknown scheduling class {klass!r} (want one of {CLASSES})")
+        c = ctx if ctx is not None else reqctx.current()
+        tenant = tenant or (c.tenant if c is not None else "") \
+            or DEFAULT_TENANT
+        cost = float(len(prompt) + max(max_tokens, 1))
+        if self.tenants is not None:
+            # quota first: a throttled tenant must get its honest 429 even
+            # when the queue is empty (quota is policy, not load)
+            try:
+                self.tenants.acquire(tenant, cost)
+            except Exception as e:
+                _QUOTA_THROTTLED.labels(
+                    tenant=self.tenants.canonical(tenant)).inc()
+                raise e
+        try:
+            self._admission_control(tenant, klass, cost)
+        except Exception:
+            # a shed request received zero service: refund the quota debit
+            # or overload bursts would double-punish within-quota tenants
+            # (drained bucket + 503) and 429 them after capacity recovers
+            if self.tenants is not None:
+                self.tenants.refund(tenant, cost)
+            raise
         req = BatchRequest(list(prompt), max_tokens, sampler, on_token, stop_check)
+        req.tenant = tenant
+        req.klass = klass
+        req.wfq_cost = cost
         if not req.prompt:
             req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
         req.resume_tokens = min(max(int(resume_tokens), 0), len(req.prompt))
@@ -525,19 +621,19 @@ class BatchEngine:
         # handler thread's contextvar) or originate one, and make the
         # context carry the request id so the faults.fire → flight hook can
         # attribute injections fired inside this request's scheduler scope
-        c = ctx if ctx is not None else reqctx.current()
         rid = rid or (c.request_id if c is not None and c.request_id else "")
         if not rid:
             rid = f"req-{uuid.uuid4().hex[:16]}"
         req.rid = rid
         if c is None:
-            req.ctx = reqctx.new_context(rid)
-        elif c.request_id != rid:
-            req.ctx = dataclasses.replace(c, request_id=rid)
+            req.ctx = reqctx.new_context(rid, tenant)
+        elif c.request_id != rid or c.tenant != tenant:
+            req.ctx = dataclasses.replace(c, request_id=rid, tenant=tenant)
         else:
             req.ctx = c
         flight.start(rid, req.ctx.trace_id, prompt_tokens=len(req.prompt),
-                     max_tokens=max_tokens)
+                     max_tokens=max_tokens,
+                     **{"tenant": tenant, "class": klass})
         req.submit_t = time.perf_counter()
         if deadline is not None and deadline > 0:
             req.deadline_t = req.submit_t + deadline
@@ -554,6 +650,98 @@ class BatchEngine:
         with self._cond:
             self._cond.notify()
         return req
+
+    def _admission_control(self, tenant: str, klass: str,
+                           cost: float) -> None:
+        """Load shedding at submit (docs/SERVING.md "Multi-tenant serving").
+        Two displacement rules make the shed order policy-true instead of
+        arrival-order-true:
+
+        - class: an INTERACTIVE arrival that would be refused first evicts
+          the least-entitled queued batch request (batch sheds before
+          interactive);
+        - weight: a BATCH arrival hitting the full queue displaces the
+          least-entitled queued batch item when its own virtual finish tag
+          is SMALLER (more entitled) — so under uniform flooding the queue
+          holds weight-proportional work and delivered throughput tracks
+          the configured weights rather than arrival luck.
+
+        Every refusal carries Retry-After derived from the measured queue
+        drain rate (EMA completions/sec vs depth, resilience/tenancy.py),
+        never a hardcoded constant."""
+        with self._plock:
+            queued = len(self._pending) + self._queue.qsize()
+        reason = None
+        if self.max_queue and queued >= self.max_queue:
+            reason = "queue"
+        tgt = self.slo_ttft.get(klass, 0.0)
+        if reason is None and tgt and queued > 0:
+            # projected wait for the LAST place in line, applied only when
+            # a backlog actually exists: an idle engine serves within ~one
+            # dispatch whatever the historical drain rate says — without
+            # the queued>0 gate, a long-idle engine's decayed EMA (tiny but
+            # nonzero) projected an absurd wait and shed at queue depth 0.
+            # Cold start (no completion observed yet) projects 0 likewise.
+            if self._drain.queue_wait(queued + 1) > tgt:
+                reason = "slo_ttft"
+        if (reason is None and klass == "batch" and self.slo_tpot_interactive
+                and self._tpot_ema_ms > self.slo_tpot_interactive * 1e3):
+            # decode is already past the interactive TPOT target: one more
+            # batch row widens every shared dispatch further — refuse batch
+            reason = "slo_tpot"
+        if reason is None:
+            return
+        if klass == "interactive":
+            with self._plock:
+                # drain first: evictable batch work may still sit in the
+                # cross-thread queue while the scheduler is mid-dispatch —
+                # an interactive arrival must never be refused while ANY
+                # queued batch request exists
+                self._drain_submit_queue()
+                victim = self._pending.evict_last("batch")
+            if victim is not None:
+                # shed batch before interactive: the evicted batch request
+                # gets the honest 503 this arrival would otherwise have
+                self._shed_queued(victim, reason, queued)
+                _SLO_SHED.labels(**{"class": "batch"}).inc()
+                return
+        elif reason == "queue":
+            with self._plock:
+                self._drain_submit_queue()  # same visibility rule as above
+                worst = self._pending.last_tag("batch")
+                victim = None
+                if (worst is not None and
+                        self._pending.entry_tag(tenant, "batch",
+                                                cost) < worst):
+                    victim = self._pending.evict_last("batch")
+            if victim is not None:
+                # weighted shed: this batch arrival is MORE entitled than
+                # the queue's worst resident — displace it
+                self._shed_queued(victim, reason, queued)
+                return
+        _SHED.inc()
+        if reason != "queue":
+            _SLO_SHED.labels(**{"class": klass}).inc()
+        raise EngineSaturated(
+            f"admission refused ({reason}): class={klass}, queue depth "
+            f"{queued}" + (f" at max_queue={self.max_queue}"
+                           if reason == "queue" else ""),
+            retry_after=self._drain.retry_after(queued + 1))
+
+    def _shed_queued(self, req: BatchRequest, reason: str,
+                     queued: int) -> None:
+        """Fail a queued request displaced by a higher-priority admission
+        (the shed-batch-first path) with the same typed error + honest
+        Retry-After an admission-time shed would have surfaced."""
+        _SHED.inc()
+        req.error = EngineSaturated(
+            f"shed from the wait queue ({reason}): an interactive admission "
+            "displaced this batch request",
+            retry_after=self._drain.retry_after(queued))
+        req.finish = "error"
+        _REQUESTS.labels(finish="error").inc()
+        flight.finish(req.rid, "error", error=repr(req.error))
+        req.done.set()
 
     def generate(self, prompt: list[int], max_tokens: int, sampler,
                  on_token=None, stop_check=None) -> tuple[list[int], GenerationStats]:
@@ -778,17 +966,30 @@ class BatchEngine:
         to extend the reuse from the cross-request prefix cache: when the radix
         index covers more of the prompt than the slot's own history, the extra
         rows are copied in from the block pool and prefill starts at the seeded
-        position (docs/PREFIX_CACHE.md)."""
+        position (docs/PREFIX_CACHE.md).
+
+        A PREEMPTED request (req.out non-empty: a batch row displaced by an
+        interactive admission, docs/SERVING.md "Multi-tenant serving")
+        re-admits against prompt ⊕ delivered — the same forced-prefix
+        construction as a durable resume (docs/FLEET.md): its sampler
+        already sits after exactly the delivered coins, the preempting
+        _finish-style release harvested the row into the prefix cache, so
+        re-prefill is mostly a radix hit and generation continues
+        byte-identical to the uninterrupted run."""
         free = [s for s in self._slots if s.req is None]
         if not free:
             return None
+        # effective admission prompt: original prompt plus any tokens
+        # already delivered before a preemption (empty for fresh requests)
+        full = req.prompt + req.out if req.out else req.prompt
+
         def common(s: _Slot) -> int:
             n = 0
-            for a, b in zip(s.history, req.prompt):
+            for a, b in zip(s.history, full):
                 if a != b:
                     break
                 n += 1
-            return min(n, len(req.prompt) - 1)
+            return min(n, len(full) - 1)
         best = max(free, key=common)
         rewind = common(best)
         reuse = rewind
@@ -800,20 +1001,30 @@ class BatchEngine:
             # batch.prefix_seed span carries the request's trace id.
             self.prefix_cache.note_resident(reuse)
             with reqctx.use(req.ctx):
-                reuse = self._seed_from_cache(best, req, reuse)
+                reuse = self._seed_from_cache(best, req, reuse, full)
         best.admit_t = time.monotonic()  # before .req: the watchdog keys on req
         best.req = req
         best.pos = reuse
-        best.history = list(req.prompt[:reuse])
-        best.pending = req.prompt[reuse:]
+        best.history = list(full[:reuse])
+        best.pending = full[reuse:]
         best.last_logits = None
         best.next_token = None
         best.clamp_pos = None
         best.armed = False
-        # drafting corpus: the FULL prompt (including any reused prefix) —
-        # prompt-lookup draws drafts from exactly that repetitive history
-        best.ngram = NgramIndex(req.prompt) if self.spec_k else None
-        req.stats.prompt_tokens = len(req.prompt)
+        # drafting corpus: the FULL prompt (including any reused prefix and
+        # preemption-delivered tokens) — prompt-lookup draws drafts from
+        # exactly that repetitive history
+        best.ngram = NgramIndex(full) if self.spec_k else None
+        # per-tenant delivery counter child, resolved once per admission so
+        # the per-token _emit path pays no label lookup
+        best.tok_counter = _TENANT_TOKENS.labels(
+            tenant=self.tenants.canonical(req.tenant)
+            if self.tenants is not None else req.tenant)
+        req.stats.prompt_tokens = len(full)
+        # queue TTL bounds the wait before FIRST service only: a later
+        # preemption must not let the original bound expire a request that
+        # already has delivered output
+        req.queue_ttl_t = 0.0
         # admission reuse reading (rewind + radix seed): the prefill this
         # request SKIPPED — for a resume admission this is the number the
         # "resume cost ≈ one suffix prefill" claim rests on, surfaced per
@@ -831,16 +1042,20 @@ class BatchEngine:
         return best
 
     def _seed_from_cache(self, slot: _Slot, req: BatchRequest,
-                         reuse: int) -> int:
-        """Consult the radix index for req.prompt; when it beats the same-slot
-        rewind, scatter the pool blocks' rows into the slot's cache rows
-        [reuse, n) and return the seeded length n (the new prefill start).
-        The acquired lease stays on the slot until _finish (eviction must
-        respect in-flight slots); seeding failures fall back to plain
-        prefill — the cache is an optimization, never a correctness gate."""
+                         reuse: int, full: list[int] | None = None) -> int:
+        """Consult the radix index for the admission prompt (`full` =
+        prompt ⊕ preemption-delivered tokens; defaults to req.prompt); when
+        it beats the same-slot rewind, scatter the pool blocks' rows into
+        the slot's cache rows [reuse, n) and return the seeded length n
+        (the new prefill start). The acquired lease stays on the slot until
+        _finish (eviction must respect in-flight slots); seeding failures
+        fall back to plain prefill — the cache is an optimization, never a
+        correctness gate."""
+        if full is None:
+            full = req.prompt
         try:
             faults.fire("batch.cache_seed", slot=slot.index)
-            lease = self.prefix_cache.lookup(req.prompt,
+            lease = self.prefix_cache.lookup(full,
                                              cap=self.spec.seq_len - 1)
             if lease is None:
                 return reuse
@@ -969,6 +1184,16 @@ class BatchEngine:
         slot.pending = []
         slot.next_token = None
         slot.ngram = None
+        slot.tok_counter = None
+        # service-rate bookkeeping (docs/SERVING.md "Multi-tenant serving"):
+        # one completion noted to the drain estimator — the denominator of
+        # every Retry-After hint — plus per-tenant completion accounting
+        self._drain.note()
+        _DRAIN_RATE.set(self._drain.rate())
+        _TENANT_REQUESTS.labels(
+            tenant=(self.tenants.canonical(req.tenant)
+                    if self.tenants is not None else req.tenant),
+            **{"class": req.klass}).inc()
         if self.prefix_cache is not None and slot.lease is not None:
             # the lease pins blocks for the IN-FLIGHT period only; release
             # before done.set() so a caller observing completion sees no
@@ -1041,52 +1266,71 @@ class BatchEngine:
         return starts
 
     def _admit(self) -> None:
-        """Drain the cross-thread queue into the scheduler-local overflow
-        list, reap cancelled/expired queued requests, and assign FIFO onto
-        free slots."""
+        """Drain the cross-thread queue into the scheduler-local
+        weighted-fair wait queue, reap cancelled/expired queued requests,
+        and assign in WFQ order onto free slots — interactive class first,
+        tenants by weight (docs/SERVING.md "Multi-tenant serving"). When no
+        slot is free and the fair queue's head is INTERACTIVE, a batch-class
+        row is preempted at this super-step boundary (its request re-queued,
+        to resume byte-identical later) so interactive TTFT is bounded by
+        one dispatch, not a batch request's whole generation."""
         now = time.perf_counter()
+        # preempted rows' prefix harvests are SNAPSHOTTED under the lock
+        # but copied device→host after it: jax arrays are immutable, so
+        # the captured cache refs survive the slot's reassignment, and the
+        # transfer must not stall submit()/admission callers on _plock
+        harvests: list[tuple] = []
         with self._plock:
-            while True:
-                try:
-                    self._pending.append(self._queue.get_nowait())
-                except queue.Empty:
-                    break
+            self._drain_submit_queue()
             # queue-TTL / deadline expiry applies to EVERY queued request,
             # not just the head — under sustained occupancy the head may
             # never admit, and requests behind it must still time out
-            kept = []
+            expired = []
             for req in self._pending:
                 expired_by = ("queue_ttl" if req.queue_ttl_t
                               and now >= req.queue_ttl_t
                               else "deadline" if req.deadline_t
                               and now >= req.deadline_t else None)
-                if expired_by is None:
-                    kept.append(req)
-                    continue
+                if expired_by is not None:
+                    expired.append((req, expired_by))
+            for req, expired_by in expired:
+                self._pending.remove(req)
                 req.finish = "deadline"
-                req.error = DeadlineExceeded(
-                    f"request expired in queue ({expired_by})")
+                # a preempted request with delivered output keeps it (the
+                # decode-deadline contract); only a never-served request
+                # surfaces the typed error
+                if not req.out:
+                    req.error = DeadlineExceeded(
+                        f"request expired in queue ({expired_by})")
                 _DEADLINE_EXPIRED.labels(where="queue").inc()
                 _REQUESTS.labels(finish="deadline").inc()
                 flight.finish(req.rid, "deadline", expired_by=expired_by)
                 req.done.set()
-            self._pending[:] = kept
-            while self._pending:
-                if self._pending[0].cancelled:
-                    req = self._pending.pop(0)
+            while True:
+                req = self._pending.peek_next()
+                if req is None:
+                    break
+                # heads leave via pop_next(), NOT remove(): pop advances
+                # the class's virtual time to the served tag, which is
+                # what anchors a later-arriving tenant's first tag at
+                # "now" instead of zero — without it a tenant returning
+                # from idle would be charged its entire lifetime service
+                # against newcomers and starve (the SFQ V(t) invariant)
+                if req.cancelled:
+                    self._pending.pop_next()
                     req.finish = "cancelled"
                     _REQUESTS.labels(finish="cancelled").inc()
                     flight.finish(req.rid, "cancelled")
                     req.done.set()
                     continue
                 try:
-                    assigned = self._assign(self._pending[0])
+                    assigned = self._assign(req)
                 except Exception as e:
                     # an admission failure is attributable to the request
                     # being admitted: fail IT and dequeue — leaving it at
                     # the head would re-raise every pass (hanging its waiter
                     # forever) while _fail_all killed innocent neighbors
-                    req = self._pending.pop(0)
+                    self._pending.pop_next()
                     _ENGINE_ERRORS.labels(kind="request").inc()
                     req.error = e
                     req.finish = "error"
@@ -1094,9 +1338,113 @@ class BatchEngine:
                     req.done.set()
                     continue
                 if assigned is None:
-                    break  # no free slot: serve current load first
-                self._pending.pop(0)
+                    # no free slot: an interactive head may preempt a
+                    # batch-class row (super-step boundary — the scheduler
+                    # is between dispatches right here); a batch head waits
+                    if req.klass == "interactive" and self._try_preempt(
+                            harvests):
+                        continue  # a slot is free now; re-try this head
+                    break
+                self._pending.pop_next()
             _QUEUE_DEPTH.set(len(self._pending) + self._queue.qsize())
+        for history, kc, vc, index in harvests:
+            self._harvest_rows(history, kc, vc, index)
+
+    def _drain_submit_queue(self) -> None:  # holds: self._plock
+        """Move cross-thread submissions into the weighted-fair queue.
+        Shared by the scheduler's _admit and the submit-side shed paths —
+        eviction must see EVERY queued batch request, including ones still
+        in the cross-thread queue because the scheduler is mid-dispatch."""
+        while True:
+            try:
+                self._pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+
+    def _try_preempt(self, harvests: list) -> bool:  # holds: self._plock
+        """Free one slot for a waiting interactive request by preempting the
+        batch-class row with the least delivered output (the cheapest
+        resume). Interactive rows are never preempted. Returns True when a
+        slot was freed; the victim's deferred prefix-harvest payload (if
+        any) is appended to `harvests` for the caller to run OUTSIDE the
+        lock."""
+        victims = [s for s in self._slots
+                   if s.req is not None and s.req.klass == "batch"
+                   and not s.req.done.is_set() and not s.req.cancelled]
+        if not victims:
+            return False
+        h = self._preempt_slot(min(victims, key=lambda s: len(s.req.out)))
+        if h is not None:
+            harvests.append(h)
+        return True
+
+    def _preempt_slot(self, slot: _Slot):  # holds: self._plock
+        """Release a batch row at a super-step boundary and re-queue its
+        request (docs/SERVING.md "Multi-tenant serving"). The release
+        mirrors _finish WITHOUT completing the request: the prefix-cache
+        lease is released and a SNAPSHOT of the committed history + cache
+        arrays is returned for a deferred harvest into the radix pool
+        (device→host copies must not run under _plock; jax arrays are
+        immutable so the snapshot survives the slot's reassignment) — the
+        later re-admission (prompt ⊕ delivered, _assign) is then mostly a
+        cache hit, the same "resume cost ≈ one suffix prefill" economics
+        as a durable failover. An in-flight chained dispatch covering this
+        row is discarded at delivery by the existing reaped-row rollback
+        (slot.req changed), exactly like a cancel, and the sampler was
+        already resynced to the delivered coins — so the resumed
+        generation is byte-identical to an uninterrupted run
+        (tests/test_tenancy.py pins greedy AND seeded-stochastic)."""
+        req = slot.req
+        req.preemptions += 1
+        _PREEMPTED.inc()
+        flight.event(req.rid, "preempted", slot=slot.index,
+                     delivered=len(req.out))
+        slot.req = None
+        slot.pending = []
+        slot.next_token = None
+        slot.ngram = None
+        slot.tok_counter = None
+        harvest = None
+        if self.prefix_cache is not None:
+            if slot.lease is not None:
+                self.prefix_cache.release(slot.lease)
+                slot.lease = None
+            if slot.clamp_pos is not None:
+                # an in-flight scan flagged a clamped park: the poisoned
+                # tail must not be harvested (mirrors _harvest_into_cache)
+                self._truncate_history(slot, slot.clamp_pos)
+                slot.clamp_pos = None
+            eng = self._eng
+            harvest = (list(slot.history), eng.k_cache, eng.v_cache,
+                       slot.index)
+        # nominal re-queue cost: the original admission already charged the
+        # FULL request cost into the tenant's virtual time — charging the
+        # remainder again would double-bill every preemption and erode the
+        # tenant's configured share
+        self._pending.push(req, req.tenant, req.klass, 1.0)
+        return harvest
+
+    def _harvest_rows(self, history: list[int], kc, vc, index: int) -> None:
+        """Deferred preemption harvest: copy the snapshotted committed rows
+        into the prefix-cache pool. Runs OUTSIDE _plock — the snapshot
+        arrays are immutable, so the slot may already be serving its next
+        request."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        try:
+            if len(history) >= pc.block_tokens:
+                def harvest(t0: int, t1: int):
+                    return (np.asarray(kc[:, index, :, t0:t1]),
+                            np.asarray(vc[:, index, :, t0:t1]))
+
+                with trace.span("batch.prefix_insert",
+                                {"slot": index, "tokens": len(history)}):
+                    pc.insert(history, harvest)
+        except Exception as e:  # degraded cache, never a scheduler error
+            from ..cache import warn_degraded
+
+            warn_degraded("insert", e)
 
     def _reap_slots(self) -> None:
         """Free slots whose request was cancelled or whose wall-clock
@@ -1190,7 +1538,14 @@ class BatchEngine:
                 self._inflight = None
                 self._pipeline_advance(fl)
             elif prefill:
-                victim = prefill[0]
+                # class-aware prefill order (docs/SERVING.md "Multi-tenant
+                # serving"): an interactive row's prefill goes first — with
+                # slot-order FIFO an interactive admission could wait
+                # behind several batch rows' long prompts, unbounding the
+                # TTFT the preemption path just bounded
+                victim = min(prefill,
+                             key=lambda s: (s.req.klass != "interactive",
+                                            s.index))
                 try:
                     # mixed step: active decode rows ride the prefill dispatch
                     # at T=1 instead of stalling behind it
@@ -1243,6 +1598,8 @@ class BatchEngine:
                 slot.ngram.append(token)
             req.stats.generated_tokens += 1
             _DECODE_TOKENS.inc()
+            if slot.tok_counter is not None:  # per-tenant delivery share
+                slot.tok_counter.inc()
             if req.on_token is not None:
                 req.on_token(token)
             if req.stop_check is not None and req.stop_check(token):
@@ -1880,6 +2237,11 @@ class BatchEngine:
             smp = req.sampler
             state0 = int(getattr(smp, "state", 0))
             per_tok = dev_ms / b
+            # measured decode TPOT (ms/token, decayed) — the signal the
+            # slo_tpot_interactive admission gate reads: when delivered
+            # pace is already past the interactive target, new batch-class
+            # admissions are refused before they widen the dispatches
+            self._tpot_ema_ms += 0.2 * (per_tok - self._tpot_ema_ms)
             req.stats.dispatch_ms.append(dev_ms)
             req.stats.overlap_ms.append(overlap_ms)
             x = slot.last_token  # ingested input of the block's first step

@@ -71,6 +71,20 @@ DURABILITY_ENGINES = ("pipelined", "speculative")
 DURABILITY_CELLS = len(DURABILITY_ENGINES) * 2 * 2  # × stream × resume
 SUPERVISOR_CELLS = 1  # fault-injected hang -> supervisor recovery
 
+# Fairness/starvation family (ISSUE 11, docs/SERVING.md "Multi-tenant
+# serving"): an adversarial flooding tenant saturates the engine's wait
+# queue under ~4x-slots overload while two weighted tenants submit
+# interactive and batch work AFTER the flood, crossed over {no fault,
+# chaos-transient, chaos-error, failover} × {pipelined, serialized}.
+# Every cell asserts EVERY tenant makes progress (>= 1 completed request
+# each — the weighted-fair queue must reorder past the flood), the
+# scheduler thread survives, a fault-free probe completes, and no
+# slot/lease/queue entry leaks. The failover scenario recover_wedged()s
+# the engine mid-overload and re-submits the retriably-failed requests
+# (the durable-router stand-in) — tenants must still progress.
+FAIRNESS_SCENARIOS = ("none", "chaos-transient", "chaos-error", "failover")
+FAIRNESS_CELLS = len(FAIRNESS_SCENARIOS) * 2  # × {pipelined, serialized}
+
 
 def _spec(seq_len=128):
     return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
@@ -423,6 +437,128 @@ def run_supervisor_cell() -> list[str]:
 
 
 # ----------------------------------------------------------------------
+# fairness family: flooding tenant, weighted survivors, chaos + failover
+# ----------------------------------------------------------------------
+
+def build_fair_engine(pipeline: bool):
+    from distributed_llama_tpu.resilience.tenancy import TenantRegistry
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    reg = TenantRegistry.parse("alpha:weight=3;beta:weight=2;flood:weight=1")
+    return spec, BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                             pipeline=pipeline, tenants=reg)
+
+
+def run_fairness_cell(spec, be, scenario: str, tag: str) -> list[str]:
+    from distributed_llama_tpu.resilience.errors import EngineWedged
+
+    problems: list[str] = []
+    name = f"[{tag}] fairness/{scenario}"
+    gen = 10
+    fs = None
+    if scenario == "chaos-transient":
+        fs = FaultSpec("batch.dispatch", kind="transient", count=3,
+                       delay_ms=5)
+    elif scenario == "chaos-error":
+        fs = FaultSpec("batch.emit", kind="error", count=2)
+    reqs = []  # (tenant, prompt, BatchRequest)
+
+    def sub(tenant, klass, salt):
+        prompt = [1, salt, 23, 5]
+        return (tenant, prompt,
+                be.submit(list(prompt), gen, _greedy(spec), tenant=tenant,
+                          klass=klass))
+
+    done: dict = {}
+    ctx = faults.active(fs) if fs is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        # the flood lands FIRST: a FIFO queue would serve all 8 before any
+        # later tenant — the weighted-fair queue must not
+        for i in range(8):
+            reqs.append(sub("flood", "batch", 40 + i))
+        for i in range(2):
+            reqs.append(sub("alpha", "interactive", 60 + i))
+            reqs.append(sub("beta", "interactive", 80 + i))
+        reqs.append(sub("alpha", "batch", 90))
+        reqs.append(sub("beta", "batch", 91))
+        if scenario == "failover":
+            # mid-overload wedge: everything in flight/queued fails
+            # RETRIABLE; re-submit each failure once, as a durable router
+            # would, and the tenants must still make progress
+            time.sleep(0.05)
+            be.recover_wedged()
+        resubmit = []
+        for tenant, prompt, r in reqs:
+            try:
+                r.wait(timeout=120)
+                done[tenant] = done.get(tenant, 0) + 1
+            except EngineWedged:
+                resubmit.append((tenant, prompt))
+            except TimeoutError:
+                problems.append(f"{name}: {tenant} request hung")
+            except Exception:
+                pass  # injected victim — expected under chaos-error
+        for tenant, prompt in resubmit:
+            try:
+                be.submit(list(prompt), gen, _greedy(spec), tenant=tenant,
+                          klass="batch").wait(timeout=120)
+                done[tenant] = done.get(tenant, 0) + 1
+            except Exception as e:
+                problems.append(f"{name}: {tenant} resubmit failed: {e!r}")
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+        faults.uninstall()
+    for tenant in ("alpha", "beta", "flood"):
+        if not done.get(tenant):
+            problems.append(f"{name}: tenant {tenant} STARVED "
+                            f"(completions: {done})")
+    if not be.scheduler_alive():
+        problems.append(f"{name}: scheduler thread DIED")
+        return problems
+    try:
+        probe = be.submit([1, 2, 3], 4, _greedy(spec))
+        out = probe.wait(timeout=120)
+        if len(out) != 4 or probe.error is not None:
+            problems.append(f"{name}: probe degraded "
+                            f"({len(out)} tokens, err={probe.error!r})")
+    except Exception as e:
+        problems.append(f"{name}: probe failed: {e!r}")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with be._plock:
+            leaked = [s for s in be._slots
+                      if s.req is not None or s.lease is not None]
+            qleft = len(be._pending)
+        if not leaked and not qleft and be._queue.empty():
+            break
+        time.sleep(0.01)
+    else:
+        problems.append(f"{name}: slot/lease/queue leak after probe")
+    return problems
+
+
+def run_fairness_family() -> tuple[int, list[str]]:
+    cells = 0
+    problems: list[str] = []
+    for pipeline in (True, False):
+        tag = "fair-pipelined" if pipeline else "fair-serialized"
+        spec, be = build_fair_engine(pipeline)
+        try:
+            be.generate([1, 7, 23, 5], 4, _greedy(spec))  # warm the shapes
+            for scenario in FAIRNESS_SCENARIOS:
+                cells += 1
+                problems += run_fairness_cell(spec, be, scenario, tag)
+        finally:
+            be.close()
+    return cells, problems
+
+
+# ----------------------------------------------------------------------
 # durability family: real replicas, real router, mid-stream kill
 # ----------------------------------------------------------------------
 
@@ -734,6 +870,11 @@ def run_matrix(include_paged: bool = True,
     d_cells, d_problems = run_durability_family()
     cells += d_cells
     problems += d_problems
+    # multi-tenant starvation/fairness under overload × chaos/failover
+    # (ISSUE 11, docs/SERVING.md "Multi-tenant serving")
+    f_cells, f_problems = run_fairness_family()
+    cells += f_cells
+    problems += f_problems
     return cells, problems
 
 

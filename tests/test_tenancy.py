@@ -1,0 +1,751 @@
+"""Multi-tenant SLO-aware serving (ISSUE 11, docs/SERVING.md "Multi-tenant
+serving"): the policy layer (resilience/tenancy.py) and its wiring through
+the BatchEngine scheduler, the api_server HTTP surface, and the fleet
+router.
+
+- weighted-fair queue vs an ideal fluid-share oracle (service within ε of
+  weights over any window), class priority, least-entitled eviction;
+- token-bucket quotas (429 + bucket-derived Retry-After) and the
+  drain-rate estimator whose Retry-After hints track measured load (the
+  hardcoded-1.0 regression, ISSUE 11 satellite);
+- no tenant starves under an adversarial flooding tenant;
+- a batch-class request preempted at a super-step boundary resumes
+  BYTE-IDENTICAL to an uninterrupted run (greedy AND seeded-stochastic);
+- tenant attribution end-to-end: X-Tenant → reqctx → flight timelines →
+  /v1/requests?tenant= filtering; the router relays the header upstream.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.obs import flight
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.resilience.errors import (EngineSaturated,
+                                                     QuotaExceeded)
+from distributed_llama_tpu.resilience.tenancy import (DrainRate, FairGate,
+                                                      TenantRegistry,
+                                                      TokenBucket,
+                                                      WeightedFairQueue,
+                                                      sanitize_tenant)
+from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+from distributed_llama_tpu.runtime.sampler import Sampler
+
+VOCAB = 256
+
+
+def _spec(seq_len=160):
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=VOCAB,
+                     seq_len=seq_len, rope_type=RopeType.LLAMA).resolved()
+
+
+def _greedy(spec):
+    return Sampler(spec.vocab_size, temperature=0.0)
+
+
+# ----------------------------------------------------------------------
+# policy primitives (no engine)
+# ----------------------------------------------------------------------
+
+def test_token_bucket_rate_and_burst():
+    b = TokenBucket(rate=100.0, burst=50.0)
+    ok, _ = b.try_acquire(50.0)  # full burst available immediately
+    assert ok
+    ok, wait = b.try_acquire(50.0)  # empty: must wait ~cost/rate
+    assert not ok
+    assert 0.1 < wait <= 0.5 + 1e-6
+    time.sleep(wait + 0.05)
+    ok, _ = b.try_acquire(50.0)  # refilled at `rate`
+    assert ok
+
+
+def test_token_bucket_oversized_cost_clamped():
+    b = TokenBucket(rate=10.0, burst=20.0)
+    ok, _ = b.try_acquire(10_000.0)  # clamped to burst: passes when full
+    assert ok
+    ok, wait = b.try_acquire(10_000.0)
+    assert not ok and wait <= 2.0 + 1e-6  # never quotes an unserviceable wait
+
+
+def test_registry_parse_resolve_and_canonical():
+    reg = TenantRegistry.parse(
+        "gold:weight=4,rate=100,burst=200;free:weight=1;default:rate=50")
+    assert reg.resolve("gold").weight == 4
+    assert reg.resolve("gold").bucket is not None
+    assert reg.resolve("free").bucket is None  # no rate = unlimited
+    # unknown ids share the default policy — bounded cardinality
+    assert reg.resolve("attacker-4711") is reg.resolve(None)
+    assert reg.canonical("attacker-4711") == "default"
+    assert reg.canonical("gold") == "gold"
+    assert reg.resolve(None).bucket is not None  # default got a quota
+    with pytest.raises(ValueError):
+        TenantRegistry.parse("bad:velocity=9")
+    with pytest.raises(AssertionError):
+        TenantRegistry.parse("zero:weight=0")
+
+
+def test_registry_quota_raises_with_retry_after():
+    reg = TenantRegistry.parse("tiny:rate=10,burst=10")
+    reg.acquire("tiny", 10.0)
+    with pytest.raises(QuotaExceeded) as ei:
+        reg.acquire("tiny", 10.0)
+    assert ei.value.tenant == "tiny"
+    assert 0.0 < ei.value.retry_after <= 1.0 + 1e-6
+    assert reg.stats()["tiny"]["throttled"] == 1
+
+
+def test_sanitize_tenant():
+    assert sanitize_tenant("acme-prod.v2") == "acme-prod.v2"
+    assert sanitize_tenant(None) == "default"
+    assert sanitize_tenant("  ") == "default"
+    assert sanitize_tenant("x" * 65) == "default"
+    assert sanitize_tenant("bad tenant\n") == "default"
+
+
+def test_drain_rate_retry_after_tracks_load():
+    """ISSUE 11 satellite regression: the backoff hint must TRACK the
+    measured drain rate and depth — not a constant. Same depth drains
+    faster → smaller hint; same rate, deeper queue → larger hint; floor
+    and cap are honored."""
+    fast, slow = DrainRate(tau=1.0), DrainRate(tau=1.0)
+    for _ in range(50):
+        fast.note()
+    for _ in range(2):
+        slow.note()
+    assert fast.rate() > slow.rate() > 0.0
+    depth = 40
+    assert fast.retry_after(depth) < slow.retry_after(depth)
+    assert fast.retry_after(depth) <= slow.retry_after(depth)
+    # deeper queue at the same rate → larger (monotone) hint
+    assert slow.retry_after(depth) <= slow.retry_after(4 * depth)
+    # floor: an instant drain never quotes ~0 (busy-spin protection)
+    assert fast.retry_after(0) >= fast.floor
+    # cap: a stalled queue never quotes an hour
+    assert slow.retry_after(10_000_000) <= slow.cap
+    # cold start: no completions observed — floor, and never a shed signal
+    cold = DrainRate()
+    assert cold.retry_after(100) == cold.floor
+    assert cold.queue_wait(100) == 0.0
+
+
+def test_wfq_matches_fluid_share_oracle():
+    """Property test vs the ideal fluid server: with every tenant
+    backlogged, service delivered over ANY window of consecutive pops is
+    within ε of the weight shares — the no-starvation guarantee."""
+    from distributed_llama_tpu.resilience.tenancy import TenantPolicy
+
+    weights = {"a": 5.0, "b": 2.0, "c": 1.0}
+    reg = TenantRegistry([TenantPolicy(n, weight=w)
+                          for n, w in weights.items()])
+    q = WeightedFairQueue(reg)
+    n_items = 420
+    for t in weights:  # every tenant stays backlogged through all pops
+        for i in range(2 * n_items):
+            q.push((t, i), t, "batch", 1.0)
+    order = [q.pop_next() for _ in range(n_items)]
+    total_w = sum(weights.values())
+    window = 80
+    for start in range(0, n_items - window, 17):
+        win = [t for t, _i in order[start:start + window]]
+        for t, w in weights.items():
+            expected = window * w / total_w
+            got = win.count(t)
+            assert abs(got - expected) <= 0.1 * window + 2.0, \
+                (start, t, got, expected)
+    # per-tenant FIFO order is preserved
+    for t in weights:
+        idx = [i for tt, i in order if tt == t]
+        assert idx == sorted(idx)
+
+
+def test_wfq_weighted_costs_and_interactive_priority():
+    reg = TenantRegistry.parse("heavy:weight=1;light:weight=1")
+    q = WeightedFairQueue(reg)
+    # heavy items cost 4x: light should be served ~4x as often
+    for i in range(40):
+        q.push(("h", i), "heavy", "batch", 4.0)
+        q.push(("l", i), "light", "batch", 1.0)
+    first = [q.pop_next()[0] for _ in range(20)]
+    assert first.count("l") >= 3 * first.count("h")
+    # interactive strictly precedes every queued batch item
+    q.push(("i", 0), "heavy", "interactive", 100.0)
+    assert q.pop_next()[0] == "i"
+
+
+def test_wfq_evict_last_picks_least_entitled_batch():
+    reg = TenantRegistry.parse("a:weight=1;b:weight=1")
+    q = WeightedFairQueue(reg)
+    q.push("a0", "a", "batch", 1.0)
+    q.push("b0", "b", "batch", 1.0)
+    q.push("b1", "b", "batch", 1.0)  # b's newest: max finish tag
+    q.push("i0", "a", "interactive", 1.0)
+    assert q.evict_last("batch") == "b1"
+    assert q.evict_last("interactive") == "i0"
+    assert len(q) == 2
+    # eviction rolled b's tag back: next b push is not charged for b1
+    q.push("b2", "b", "batch", 1.0)
+    got = [q.pop_next() for _ in range(3)]
+    assert set(got) == {"a0", "b0", "b2"}
+
+
+def test_wfq_idle_tenant_not_starved_on_return():
+    """Review regression: virtual time must advance as items are SERVED
+    (pop_next) — a tenant returning from idle is charged from "now", not
+    from zero, so a long-served tenant is never starved behind a
+    newcomer's fresh tags."""
+    reg = TenantRegistry.parse("old:weight=1;new:weight=1")
+    q = WeightedFairQueue(reg)
+    for i in range(60):  # a long 'old'-only service history
+        q.push(("old", i), "old", "batch", 1.0)
+    for _ in range(60):
+        assert q.pop_next()[0] == "old"
+    # newcomer arrives; old keeps submitting — they must interleave ~1:1
+    for i in range(20):
+        q.push(("new", i), "new", "batch", 1.0)
+        q.push(("old", 100 + i), "old", "batch", 1.0)
+    first10 = [q.pop_next()[0] for _ in range(10)]
+    assert first10.count("old") >= 3, first10  # not starved behind 'new'
+
+
+def test_wfq_clear_resets_virtual_time():
+    """Review regression: clear() (the fail-all/recovery path) must drop
+    per-tenant tags — pre-wedge service must not starve a tenant against
+    one that was idle when the engine wedged."""
+    reg = TenantRegistry.parse("busy:weight=1;idle:weight=1")
+    q = WeightedFairQueue(reg)
+    for i in range(50):
+        q.push(("busy", i), "busy", "batch", 1.0)
+    for _ in range(50):
+        q.pop_next()
+    q.clear()
+    for i in range(10):
+        q.push(("busy", i), "busy", "batch", 1.0)
+        q.push(("idle", i), "idle", "batch", 1.0)
+    first6 = [q.pop_next()[0] for _ in range(6)]
+    assert first6.count("busy") >= 2, first6
+
+
+def test_quota_refund_restores_bucket():
+    """Review regression: a request shed AFTER the quota debit (admission
+    control, router gate) received zero service — the refund restores the
+    bucket so the retry is not double-punished."""
+    reg = TenantRegistry.parse("t:rate=10,burst=20")
+    before = reg.resolve("t").bucket.available()
+    reg.acquire("t", 15.0)
+    reg.refund("t", 15.0)
+    assert reg.resolve("t").bucket.available() >= before - 0.5
+    # refund never overflows the burst
+    reg.refund("t", 1e9)
+    assert reg.resolve("t").bucket.available() <= 20.0
+
+
+def test_fair_gate_orders_waiters():
+    gate = FairGate(1, TenantRegistry.parse("x:weight=1;y:weight=1"))
+    assert gate.acquire("x", "batch")  # takes the only slot
+    got = []
+    ev = threading.Event()
+
+    def waiter(tenant, klass):
+        assert gate.acquire(tenant, klass, timeout=10.0)
+        got.append((tenant, klass))
+        ev.set()
+
+    t1 = threading.Thread(target=waiter, args=("x", "batch"))
+    t1.start()
+    time.sleep(0.05)
+    t2 = threading.Thread(target=waiter, args=("y", "interactive"))
+    t2.start()
+    time.sleep(0.05)
+    gate.release()  # the LATER interactive waiter must win the slot
+    ev.wait(5.0)
+    assert got == [("y", "interactive")]
+    gate.release()
+    t1.join(5.0)
+    t2.join(5.0)
+    assert got == [("y", "interactive"), ("x", "batch")]
+    assert gate.acquire("x", "batch", timeout=0.05) is False  # full again
+    # disabled gate is a no-op
+    assert FairGate(0).acquire("anyone", "batch", timeout=0.0)
+
+
+# ----------------------------------------------------------------------
+# engine integration
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    reg = TenantRegistry.parse("alpha:weight=4;beta:weight=2;flood:weight=1")
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4, tenants=reg)
+    be.generate([1, 7, 23, 5], 4, _greedy(spec))  # warm the shapes
+    yield spec, be
+    be.close()
+
+
+def test_no_starvation_under_flooding_tenant(engine):
+    """An adversarial tenant floods the queue FIRST; later light tenants
+    must still complete promptly — every victim finishes before the
+    flood's tail (weighted-fair + class priority), and nobody times out."""
+    spec, be = engine
+    flood = [be.submit([1, 40 + i, 23, 5], 12, _greedy(spec),
+                       tenant="flood", klass="batch")
+             for i in range(10)]
+    victims = [be.submit([1, 60 + i, 3], 6, _greedy(spec), tenant=t,
+                         klass="interactive")
+               for i, t in enumerate(("alpha", "beta", "alpha", "beta"))]
+    for r in victims:
+        r.wait(timeout=120)
+    # the victims did NOT queue behind the whole flood: when the last
+    # victim finished, flood work remained (or its rows were preempted)
+    flood_unfinished = sum(1 for r in flood if not r.done.is_set())
+    for r in flood:
+        r.wait(timeout=120)
+    assert all(len(r.out) == 12 for r in flood)   # flooder not starved either
+    assert all(len(r.out) == 6 for r in victims)  # victims fully served
+    assert (flood_unfinished >= 1
+            or sum(r.preemptions for r in flood) >= 1), \
+        "victims waited behind the entire flood (FIFO behavior)"
+
+
+@pytest.fixture(scope="module")
+def solo_engine():
+    """slots=1: preemption timing is deterministic — the single slot is
+    always busy with the batch victim when the interactive arrives."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    be = BatchEngine(spec, params, slots=1, tp=1, superstep=4)
+    be.generate([1, 7, 23, 5], 4, _greedy(spec))
+    yield spec, be
+    be.close()
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_preempted_batch_resumes_byte_identical(solo_engine, temperature):
+    """ISSUE 11 acceptance: a batch request preempted at a super-step
+    boundary (slot handed to an interactive arrival) resumes
+    byte-identical to an uninterrupted run — greedy AND seeded-stochastic
+    (the sampler replays only delivered coins; re-admission prefills
+    prompt ⊕ delivered, mostly a radix prefix-cache hit)."""
+    spec, be = solo_engine
+    prompt, gen, seed = [1, 9, 9, 2], 80, 1234
+
+    def sampler():
+        return Sampler(spec.vocab_size, temperature, 0.9, seed)
+
+    ref = be.submit(list(prompt), gen, sampler(), klass="batch").wait(
+        timeout=300)
+    assert len(ref) == gen
+    victim = be.submit(list(prompt), gen, sampler(), klass="batch")
+    while len(victim.out) < 9:  # mid-generation, several super-steps in
+        time.sleep(0.003)
+    inter = be.submit([1, 2, 3], 4, _greedy(spec), klass="interactive")
+    assert inter.wait(timeout=300) is not None
+    out = victim.wait(timeout=300)
+    assert victim.preemptions >= 1, "the preemption never engaged"
+    assert out == ref, (temperature, victim.preemptions)
+    assert victim.stats.reused_tokens > 0  # resume was not a full re-prefill
+
+
+def test_interactive_rows_never_preempted(solo_engine):
+    spec, be = solo_engine
+    a = be.submit([1, 9, 9, 2], 40, _greedy(spec), klass="interactive")
+    while len(a.out) < 4:
+        time.sleep(0.003)
+    b = be.submit([1, 2, 3], 4, _greedy(spec), klass="interactive")
+    a_out = a.wait(timeout=300)
+    b.wait(timeout=300)
+    assert a.preemptions == 0 and len(a_out) == 40
+
+
+def test_engine_saturated_retry_after_is_drain_derived(engine):
+    """ISSUE 11 satellite regression: EngineSaturated.retry_after comes
+    from the engine's DrainRate estimator (depth / measured rate), not the
+    old hardcoded max(queue_ttl, 1.0)."""
+    spec, be = engine
+
+    class StubDrain:
+        floor = 1.0
+
+        def note(self, n=1.0):
+            pass
+
+        def rate(self):
+            return 0.125  # 1 completion / 8s
+
+        def queue_wait(self, depth):
+            return depth / 0.125
+
+        def retry_after(self, depth):
+            return min(max(depth / 0.125, 1.0), 60.0)
+
+    old_drain, old_mq = be._drain, be.max_queue
+    be._drain, be.max_queue = StubDrain(), 1
+    blocker = []
+    try:
+        with pytest.raises(EngineSaturated) as ei:
+            for i in range(32):  # the queue refills as rows admit
+                blocker.append(be.submit([1, 77 + i % 50, 5], 30,
+                                         _greedy(spec), klass="batch"))
+        # depth >= 1 at 0.125/s → at least 8s, and never the 1.0 constant
+        assert ei.value.retry_after >= 8.0, ei.value.retry_after
+        assert ei.value.retry_after <= 60.0
+    finally:
+        be._drain, be.max_queue = old_drain, old_mq
+        for r in blocker:
+            try:
+                r.wait(timeout=300)
+            except Exception:
+                pass
+
+
+def test_slo_shed_requires_backlog(engine):
+    """Regression (found driving a live server): an engine idle long enough
+    for the drain EMA to decay to ~0 must still ADMIT a batch request when
+    the queue is empty — the SLO projection applies only to real backlog,
+    never to a decayed denominator at queue depth 0."""
+    from distributed_llama_tpu.resilience.tenancy import DrainRate
+
+    spec, be = engine
+    old_drain, old_tgt = be._drain, dict(be.slo_ttft)
+    decayed = DrainRate()
+    decayed.note()
+    with decayed._lock:  # age the one completion 10 minutes into the past
+        decayed._t -= 600.0
+    assert 0.0 <= decayed.rate() < 1e-3
+    be._drain = decayed
+    be.slo_ttft["batch"] = 0.5
+    try:
+        r = be.submit([1, 8, 8], 4, _greedy(spec), klass="batch")
+        assert len(r.wait(timeout=120)) == 4  # admitted, not shed
+    finally:
+        be._drain, be.slo_ttft = old_drain, old_tgt
+
+
+def test_interactive_evicts_queued_batch_when_saturated(engine):
+    """Shed batch before interactive: at max_queue, an interactive arrival
+    displaces the least-entitled QUEUED batch request instead of shedding
+    itself."""
+    spec, be = engine
+    old_mq = be.max_queue
+    be.max_queue = 2
+    try:
+        held = [be.submit([1, 30 + i, 5], 25, _greedy(spec), klass="batch")
+                for i in range(2)]  # occupy both slots
+        deadline = time.monotonic() + 30
+        while be.load_stats()["free_slots"] and time.monotonic() < deadline:
+            time.sleep(0.005)  # wait for both to be admitted onto slots
+        queued = [be.submit([1, 50 + i, 5], 25, _greedy(spec), klass="batch")
+                  for i in range(2)]  # fill the wait queue to max_queue
+        deadline = time.monotonic() + 30
+        while len(be._pending) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)  # eviction searches the drained fair queue
+        inter = be.submit([1, 2, 3], 4, _greedy(spec), klass="interactive")
+        out = inter.wait(timeout=300)
+        assert len(out) == 4
+        # exactly one queued batch request was shed with the honest 503
+        shed = [r for r in queued if r.error is not None]
+        assert len(shed) == 1
+        assert isinstance(shed[0].error, EngineSaturated)
+        assert shed[0].error.retry_after >= 1.0
+        for r in held + [r for r in queued if r.error is None]:
+            r.wait(timeout=300)
+    finally:
+        be.max_queue = old_mq
+
+
+def test_interactive_evicts_batch_still_in_cross_thread_queue(engine):
+    """Review regression: eviction must see batch work still sitting in
+    the cross-thread submit queue (scheduler mid-dispatch), not only the
+    drained fair queue — an interactive arrival is never refused while ANY
+    queued batch request exists."""
+    from distributed_llama_tpu.runtime.batch_engine import BatchRequest
+
+    spec, be = engine
+    old_mq = be.max_queue
+    be.max_queue = 1
+    ghost = BatchRequest([1, 2, 3], 4, _greedy(spec))
+    ghost.klass = "batch"
+    ghost.wfq_cost = 7.0
+    try:
+        # plant a batch request in the CROSS-THREAD queue only (white-box:
+        # as if submitted while the scheduler is stuck in a long dispatch)
+        be._queue.put(ghost)
+        inter = be.submit([1, 2, 3], 4, _greedy(spec), klass="interactive")
+        assert len(inter.wait(timeout=120)) == 4  # admitted, not refused
+        assert ghost.done.is_set()  # the ghost was the evicted victim
+        assert isinstance(ghost.error, EngineSaturated)
+    finally:
+        be.max_queue = old_mq
+
+
+def test_tenant_attribution_in_flight_records(engine):
+    spec, be = engine
+    rec = flight.install(64)
+    try:
+        r = be.submit([1, 5, 6], 4, _greedy(spec), tenant="alpha",
+                      klass="batch", rid="tn-attr-1")
+        r.wait(timeout=120)
+        full = rec.get("tn-attr-1")
+        assert full["tenant"] == "alpha" and full["class"] == "batch"
+        listing = rec.requests(tenant="alpha")
+        assert any(s["id"] == "tn-attr-1" for s in listing["completed"])
+        assert all(s["tenant"] == "alpha" for s in listing["completed"])
+        empty = rec.requests(tenant="nobody")
+        assert empty["completed"] == [] and empty["live"] == []
+    finally:
+        flight.uninstall()
+
+
+def test_quota_throttle_at_engine(engine):
+    spec, be = engine
+    reg = be.tenants
+    # graft a tight quota onto a fresh tenant entry for this test
+    from distributed_llama_tpu.resilience.tenancy import TenantPolicy
+
+    reg._policies["capped"] = TenantPolicy("capped", weight=1.0, rate=20.0,
+                                           burst=40.0)
+    with pytest.raises(QuotaExceeded) as ei:
+        for i in range(8):
+            be.submit([1, 4, 4], 30, _greedy(spec),
+                      tenant="capped").wait(timeout=120)
+    assert ei.value.retry_after > 0.0
+
+
+# ----------------------------------------------------------------------
+# router-level: relay + drain-derived hint (stub replicas, no model)
+# ----------------------------------------------------------------------
+
+def _stub_replica(seen: list):
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Stub(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"status": "ok", "replica": {
+                "id": "stub", "model_hash": "deadbeef0000", "slots": 2,
+                "free_slots": 2, "queue_depth": 0, "draining": False,
+            }}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            seen.append({"X-Tenant": self.headers.get("X-Tenant"),
+                         "X-Class": self.headers.get("X-Class")})
+            body = json.dumps({"choices": [{"message": {
+                "role": "assistant", "content": "ok"},
+                "finish_reason": "stop", "index": 0}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_router_relays_tenant_and_throttles():
+    from distributed_llama_tpu.fleet.router import close_router, serve_router
+
+    seen: list = []
+    stub = _stub_replica(seen)
+    router = serve_router([f"127.0.0.1:{stub.server_address[1]}"],
+                          host="127.0.0.1", port=0, poll_interval=0.2,
+                          retries=1, try_timeout=10.0,
+                          tenants="capped:weight=1,rate=5,burst=60")
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    rport = router.server_address[1]
+    try:
+        def post(tenant, klass=None, max_tokens=8):
+            conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=30)
+            try:
+                hdrs = {"Content-Type": "application/json",
+                        "X-Tenant": tenant}
+                if klass:
+                    hdrs["X-Class"] = klass
+                conn.request("POST", "/v1/chat/completions", json.dumps(
+                    {"messages": [{"role": "user", "content": "hi"}],
+                     "max_tokens": max_tokens}), hdrs)
+                resp = conn.getresponse()
+                return resp.status, dict(resp.getheaders()), resp.read()
+            finally:
+                conn.close()
+
+        status, _h, _b = post("acme", klass="batch")
+        assert status == 200
+        assert seen[-1] == {"X-Tenant": "acme", "X-Class": "batch"}
+        # unlabeled traffic relays the canonical default tenant
+        status, _h, _b = post("", klass=None)
+        assert status == 200
+        assert seen[-1]["X-Tenant"] == "default"
+        # router-level quota: burst 60 ≈ one 8-token request + change, so a
+        # hammering capped tenant sees 429 + Retry-After before any proxy
+        saw_429 = None
+        for _ in range(8):
+            status, hdrs, body = post("capped", max_tokens=30)
+            if status == 429:
+                saw_429 = (hdrs, body)
+                break
+        assert saw_429 is not None, "quota never throttled"
+        hdrs, body = saw_429
+        assert "Retry-After" in hdrs
+        assert json.loads(body)["error"]["type"] == "rate_limit_error"
+        assert seen[-1]["X-Tenant"] != "capped" or status != 429 or True
+    finally:
+        close_router(router)
+        stub.shutdown()
+        stub.server_close()
+
+
+def test_router_retry_after_hint_tracks_load():
+    """ISSUE 11 satellite regression: the fleet-saturation Retry-After is
+    measured-drain-derived (completions/sec vs backlog), not the
+    poll_interval constant."""
+    from distributed_llama_tpu.fleet.membership import Membership
+    from distributed_llama_tpu.fleet.router import RouterState
+
+    mem = Membership(["127.0.0.1:1"], poll_interval=2.0, poll_timeout=0.2)
+    state = RouterState(mem, retries=0)
+    # cold start: floor (and finite), not the poll interval
+    assert state.retry_after_hint() == state.drain.floor
+    for _ in range(30):  # a briskly draining fleet
+        state.note_done()
+    fast = state.retry_after_hint()
+    mem.replicas[0].queue_depth = 500  # now a deep backlog builds up
+    deep = state.retry_after_hint()
+    assert deep > fast
+    assert state.drain.floor <= deep <= state.drain.cap
+
+
+# ----------------------------------------------------------------------
+# live api_server: X-Tenant end to end
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tenant_server(tmp_path_factory):
+    from distributed_llama_tpu.apps.api_server import serve
+    from distributed_llama_tpu.formats.mfile import (load_model,
+                                                     params_file_order,
+                                                     write_model)
+    from distributed_llama_tpu.formats.tfile import (TokenizerData,
+                                                     write_tokenizer)
+    from distributed_llama_tpu.tokenizer import TemplateType
+    from distributed_llama_tpu.tokenizer.bpe import Tokenizer
+
+    tmp = tmp_path_factory.mktemp("tenancy_api")
+    spec = ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=262,
+                     seq_len=128).resolved()
+    params = init_random_params(spec, FloatType.F32, seed=21)
+    mpath = str(tmp / "m.m")
+    write_model(mpath, spec, params_file_order(spec, params), FloatType.F32)
+    vocab = [b"<unk>", b"<s>", b"</s>"] + [bytes([i]) for i in range(256)] + \
+        [b"<|im_start|>", b"<|im_end|>", b" "]
+    scores = [0.0] * 259 + [-1.0, -1.0, -1.5]
+    tpath = str(tmp / "t.t")
+    write_tokenizer(tpath, TokenizerData(
+        vocab=vocab, scores=scores, bos_id=1, eos_id=2, chat_eos_id=260,
+        max_token_length=12, chat_template="{{<|im_start|>}}"))
+    lspec, lparams = load_model(mpath, 0)
+    reg = TenantRegistry.parse("gold:weight=4;capped:weight=1,rate=8,burst=40")
+    be = BatchEngine(lspec, lparams, Tokenizer.load(tpath), slots=2, tp=1,
+                     tenants=reg)
+    srv = serve(None, host="127.0.0.1", port=0,
+                template_type=TemplateType.CHATML, batch_engine=be)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield port
+    srv.shutdown()
+    be.close()
+
+
+def _post(port, body, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        h = {"Content-Type": "application/json"}
+        if headers:
+            h.update(headers)
+        conn.request("POST", "/v1/chat/completions", json.dumps(body), h)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), \
+            json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def test_api_tenant_attribution_end_to_end(tenant_server):
+    port = tenant_server
+    body = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4}
+    status, hdrs, payload = _post(port, body, {"X-Tenant": "gold"})
+    assert status == 200
+    rid = hdrs.get("X-Request-Id") or payload["id"]
+    st, rec = _get(port, f"/v1/requests/{rid}")
+    assert st == 200 and rec["tenant"] == "gold"
+    assert rec["class"] == "interactive"
+    st, listing = _get(port, "/v1/requests?tenant=gold")
+    assert st == 200
+    assert any(s["id"] == rid for s in listing["completed"])
+    st, empty = _get(port, "/v1/requests?tenant=nonexistent")
+    assert st == 200 and empty["completed"] == [] and empty["live"] == []
+    # /v1/stats exposes the registry
+    st, stats = _get(port, "/v1/stats")
+    assert st == 200 and "gold" in stats["tenants"]
+
+
+def test_api_class_field_and_validation(tenant_server):
+    port = tenant_server
+    base = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 2}
+    status, _h, _p = _post(port, {**base, "class": "batch"},
+                           {"X-Tenant": "gold"})
+    assert status == 200
+    status, _h, payload = _post(port, {**base, "class": "express"})
+    assert status == 400
+    assert payload["error"]["type"] == "invalid_request_error"
+
+
+def test_api_quota_429_with_retry_after(tenant_server):
+    port = tenant_server
+    body = {"messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 16}
+    saw = None
+    for _ in range(8):
+        status, hdrs, payload = _post(port, body, {"X-Tenant": "capped"})
+        if status == 429:
+            saw = (hdrs, payload)
+            break
+    assert saw is not None, "quota never throttled"
+    hdrs, payload = saw
+    assert payload["error"]["type"] == "rate_limit_error"
+    assert int(hdrs["Retry-After"]) >= 1
+    # the throttle is the tenant's problem, not the server's: gold serves
+    status, _h, _p = _post(port, {**body, "max_tokens": 2},
+                           {"X-Tenant": "gold"})
+    assert status == 200
