@@ -363,10 +363,20 @@ def shared_prefix_workload(args, spec):
     samples = []
     out = {}
     try:
-        for label, on in (("on", True), ("off", False)):
+        # three arms on the identical schedule: "on" = paged KV + directory
+        # (the default serving config), "off" = cache disabled, "dense" =
+        # the --no-paged-kv contiguous layout whose admission seed SCATTERS
+        # pool rows host→device — the baseline the seed_bytes column
+        # compares against (docs/PAGED_KV.md)
+        for label, on, paged in (("on", True, True), ("off", False, True),
+                                 ("dense", True, False)):
+            # the dense arm exists for the seed-cost columns only (its TTFT
+            # is not reported): a warm + 2 seeded followers suffice, keeping
+            # the 3-arm bench's wall time near the old 2-arm run's
+            arm_req = n_req if label != "dense" else min(n_req, 3)
             be = BatchEngine(spec, params, slots=B,
                              superstep=max(args.superstep, 1), tp=args.tp,
-                             prefix_cache=on)
+                             prefix_cache=on, paged_kv=paged)
             try:
                 be.generate(list(prompts[0]), gen,
                             Sampler(spec.vocab_size, temperature=0.0))
@@ -380,7 +390,7 @@ def shared_prefix_workload(args, spec):
                     return cb
 
                 reqs = []
-                for i in range(1, n_req):
+                for i in range(1, arm_req):
                     t0s[i] = time.perf_counter()
                     reqs.append(be.submit(
                         list(prompts[i]), gen,
@@ -415,12 +425,25 @@ def shared_prefix_workload(args, spec):
                     "e2e_p99_ms": _pct_ms(req_e2e, 0.99),
                     "e2e_s": round(e2e, 3),
                 }
-                if on:
+                out[label]["prefix_seed_ms"] = round(be.seed_ms, 3)
+                out[label]["seed_bytes_transferred"] = be.seed_bytes
+                if on and paged:
                     st = be.prefix_cache.stats()
                     out["prefix_hit_rate"] = round(st["hit_rate"], 3)
                     out["lookup_hit_rate"] = round(st["lookup_hit_rate"], 3)
                     out["hit_tokens"] = st["hit_tokens"]
                     out["pool_blocks"] = st["pool_blocks"]
+                    # ISSUE 12 acceptance, asserted IN-RUN: an admission
+                    # with a radix prefix hit moves ZERO host→device KV
+                    # bytes on the paged path (block-table remap only)
+                    assert st["hit_tokens"] > 0, "no radix hit in the run"
+                    assert be.seed_bytes == 0, (
+                        f"paged admission moved {be.seed_bytes} KV bytes "
+                        "host→device (remap must move none)")
+                elif on and not paged:
+                    st = be.prefix_cache.stats()
+                    assert st["hit_tokens"] == 0 or be.seed_bytes > 0, (
+                        "dense baseline seeded without any byte transfer?")
             finally:
                 be.close()
     finally:
@@ -442,9 +465,77 @@ def shared_prefix_workload(args, spec):
         "prefix_hit_rate": out["prefix_hit_rate"],
         "lookup_hit_rate": out["lookup_hit_rate"],
         "hit_tokens": out["hit_tokens"], "pool_blocks": out["pool_blocks"],
+        # paged-vs-dense admission seeding cost (docs/PAGED_KV.md): the
+        # paged remap moves ZERO KV bytes (asserted above); the dense
+        # scatter baseline pays the full fetched span per seeded admission
+        "prefix_seed_ms": out["on"]["prefix_seed_ms"],
+        "seed_bytes_transferred": out["on"]["seed_bytes_transferred"],
+        "prefix_seed_ms_dense": out["dense"]["prefix_seed_ms"],
+        "seed_bytes_dense": out["dense"]["seed_bytes_transferred"],
         "requests": n_req, "shared_prefix": shared_len, "batch": B,
         "superstep": max(args.superstep, 1),
     }))
+
+
+def long_context_workload(args):
+    """--workload shared-prefix --long-context: the KV-capacity↔slot-count
+    decoupling demo (docs/PAGED_KV.md). A 4-slot engine gets a device pool
+    holding ~1.25 contexts' worth of blocks — the DENSE layout at the same
+    KV byte budget would cap every slot at ~pool/4 tokens — and ONE request
+    runs a context ~3x that dense-equivalent per-slot capacity to the
+    context wall, while short co-batched requests keep being served. The
+    run FAILS (nonzero exit via assert) if the long request cannot finish
+    at full length."""
+    from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.quants import FloatType as _FTy
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    slots, bt = 4, 16
+    spec = ModelSpec(**dict(TINY_REP, seq_len=1024)).resolved()
+    w = spec.seq_len // bt  # blocks per full context
+    pool_blocks = w + w // 4 + 2  # ~1.25 contexts + scratch/spare
+    params = init_random_params(spec, _FTy.Q40, seed=0)
+    be = BatchEngine(spec, params, slots=slots, superstep=max(args.superstep, 1),
+                     tp=args.tp, kv_block_tokens=bt, kv_pool_blocks=pool_blocks)
+    assert be.kv_pool is not None
+    dense_equiv_per_slot = pool_blocks * bt // slots
+    rng = np.random.default_rng(0)
+    long_prompt = [1] + [int(t) for t in
+                         rng.integers(2, spec.vocab_size, 799)]
+    gen = spec.seq_len - len(long_prompt)  # decode to the context wall
+    try:
+        t0 = time.perf_counter()
+        req = be.submit(list(long_prompt), gen,
+                        Sampler(spec.vocab_size, temperature=0.0))
+        shorts = [be.submit([1, 7 + i, 9], 8,
+                            Sampler(spec.vocab_size, temperature=0.0))
+                  for i in range(3)]
+        out = req.wait(timeout=1200)
+        for r in shorts:
+            r.wait(timeout=1200)
+        dt = time.perf_counter() - t0
+        ctx = len(long_prompt) + len(out)
+        assert req.finish == "length" and ctx >= spec.seq_len, (
+            req.finish, ctx)
+        assert ctx > dense_equiv_per_slot, "demo geometry broken"
+        elem = be._eng.k_cache.dtype.itemsize
+        blk_bytes = (2 * spec.n_layers * spec.n_kv_heads * bt
+                     * spec.head_size * elem)
+        print(json.dumps({
+            "metric": "long_context_tokens", "value": ctx, "unit": "tokens",
+            "vs_baseline": None,
+            "dense_equiv_per_slot_tokens": dense_equiv_per_slot,
+            "context_vs_dense_per_slot": round(ctx / dense_equiv_per_slot, 2),
+            "slots": slots, "seq_len": spec.seq_len,
+            "kv_pool_blocks": pool_blocks, "block_tokens": bt,
+            "kv_pool_bytes": pool_blocks * blk_bytes,
+            "dense_layout_bytes": slots * (spec.seq_len // bt) * blk_bytes,
+            "short_requests_served": len(shorts),
+            "e2e_s": round(dt, 3),
+        }))
+    finally:
+        be.close()
 
 
 def _write_fleet_model(outdir: str) -> tuple[str, str]:
@@ -874,7 +965,8 @@ def batched_engine_bench(args, spec):
     params = init_random_params(spec, _FTy.Q40, seed=0)
     be = BatchEngine(spec, params, slots=B, superstep=K, tp=args.tp,
                      pipeline=bool(args.pipeline), prefix_cache=False,
-                     speculative=args.speculative)
+                     speculative=args.speculative,
+                     paged_kv=not args.no_paged_kv)
 
     def _gap_state():
         h = obs_metrics.snapshot().get("batch_dispatch_gap_seconds") or {}
@@ -963,7 +1055,7 @@ def repetition_workload(args, spec):
     params = init_random_params(spec, _FTy.Q40, seed=0)
     be = BatchEngine(spec, params, slots=B, superstep=K, tp=args.tp,
                      pipeline=pipeline, prefix_cache=False,
-                     speculative=sk or 8)
+                     speculative=sk or 8, paged_kv=not args.no_paged_kv)
 
     def round_(spec_on):
         be.spec_k = (sk or 8) if spec_on else 0
@@ -1049,7 +1141,8 @@ def chaos_workload(args, spec):
     params = init_random_params(spec, _FTy.Q40, seed=0)
     B = args.batch if args.batch > 0 else min(max(n_req // 2, 2), 8)
     be = BatchEngine(spec, params, slots=B,
-                     superstep=max(args.superstep, 1), tp=args.tp)
+                     superstep=max(args.superstep, 1), tp=args.tp,
+                     paged_kv=not args.no_paged_kv)
     out = {}
     samples = []
     try:
@@ -1391,7 +1484,8 @@ def trace_workload(args, spec):
         "gold:weight=3;silver:weight=2;bronze:weight=1;capped:weight=1")
     params = init_random_params(spec, _FTy.Q40, seed=0)
     be = BatchEngine(spec, params, slots=B, superstep=K, tp=args.tp,
-                     tenants=reg, max_queue=4 * B)
+                     tenants=reg, max_queue=4 * B,
+                     paged_kv=not args.no_paged_kv)
     greedy = lambda: Sampler(spec.vocab_size, temperature=0.0)  # noqa: E731
 
     def lens(n, mean_log, sigma, lo, hi):
@@ -1817,6 +1911,17 @@ def main():
     ap.add_argument("--shared-prefix", type=int, default=192, metavar="T",
                     help="shared-prefix workload: tokens in the common system "
                          "prompt (clamped to fit seq_len)")
+    ap.add_argument("--no-paged-kv", action="store_true",
+                    help="escape hatch: run BatchEngine workloads on the "
+                         "dense contiguous per-slot KV caches instead of the "
+                         "device block pool + tables (docs/PAGED_KV.md) — "
+                         "the A/B control for the paged columns")
+    ap.add_argument("--long-context", action="store_true",
+                    help="shared-prefix workload variant: demonstrate the "
+                         "paged pool's KV-capacity↔slot-count decoupling — "
+                         "one request runs a context LONGER than slot-count × "
+                         "the dense-equivalent per-slot capacity at the same "
+                         "KV memory budget (docs/PAGED_KV.md)")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed region here")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
@@ -2022,7 +2127,12 @@ def main():
     on_tpu = backend == "tpu"
     spec = ModelSpec(**(SMALL if args.small else ARCHS[args.arch])).resolved()
     if args.workload == "shared-prefix":
-        if args.replicas >= 1:
+        if args.long_context:
+            # paged capacity decoupling demo (docs/PAGED_KV.md): a context
+            # longer than slot-count x the dense-equivalent per-slot
+            # capacity fits, because KV capacity is the POOL, not B slots
+            long_context_workload(args)
+        elif args.replicas >= 1:
             # --replicas 1 is the single-replica fleet baseline: the SAME
             # request schedule + router proxy, so the N>=2 comparison isolates
             # routing (docs/FLEET.md); 0 = the in-process PR 3 workload

@@ -108,14 +108,21 @@ class CompileAudit:
 
         self._stack = ExitStack()
 
+        def _paged(kw):
+            # device-resident paged KV (docs/PAGED_KV.md): the block size is
+            # part of the cache key — a paged program's table/pool shapes
+            # are distinct lowerings from the dense layout's
+            bt = kw.get("kv_block_tokens", 0)
+            return f",paged={bt}" if bt else ""
+
         def _static(kw):
             return (f"mode={kw.get('mode', 'greedy')},"
-                    f"window={kw.get('attn_window')}")
+                    f"window={kw.get('attn_window')}{_paged(kw)}")
 
         self._patch_factory(
             engine, "make_sharded_forward",
             lambda spec, mesh, params, **kw:
-                f"forward_step[window={kw.get('attn_window')}]")
+                f"forward_step[window={kw.get('attn_window')}{_paged(kw)}]")
         self._patch_factory(
             device_loop, "make_decode_loop",
             lambda spec, mesh, params, n, **kw:
@@ -206,6 +213,14 @@ def run_scenario(keep_engine: bool = False):
         smp.fast_forward(len(out_s))
         rr = eng.submit(p1 + out_s, 6, smp, resume_tokens=len(out_s))
         rr.wait(60)
+        # phase 5 — paged remap admission (docs/PAGED_KV.md): re-admit a
+        # directory-covered prompt so the zero-copy block-table remap path
+        # runs. Remap is table METADATA only — it must ride the existing
+        # prefill/scan programs at their pinned signatures; a remap-shaped
+        # program key or a table-shape drift here is exactly the
+        # block-table recompile creep this gate exists to catch.
+        rm = eng.submit(list(p2), 6, Sampler(V))
+        rm.wait(60)
         ok = True
     finally:
         # a failed phase must not leak a live engine (scheduler thread +

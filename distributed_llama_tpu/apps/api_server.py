@@ -262,6 +262,10 @@ def _stats_payload(state: "ApiState") -> dict:
             "max_queue": be.max_queue,
             "queue_ttl": be.queue_ttl,
         }
+        if be.kv_pool is not None:  # device-resident paged KV state
+            out["batch_engine"]["paged_kv"] = dict(
+                be.kv_pool.stats(), seed_bytes=be.seed_bytes,
+                seed_ms=round(be.seed_ms, 3))
         if be.spec_k:
             snap = metrics.snapshot()
             drafted = snap.get("batch_spec_drafted_tokens_total", 0)
@@ -1094,6 +1098,22 @@ def main(argv=None) -> None:
                         "than f32) — capacity over bit-exactness: a cold hit "
                         "is a near-lossless dequantized seed, not an exact "
                         "replay (docs/PREFIX_CACHE.md cost model)")
+    p.add_argument("--no-paged-kv", action="store_true",
+                   help="escape hatch: revert --batch engines to the dense "
+                        "per-slot contiguous KV caches instead of the "
+                        "device-resident block pool + block tables "
+                        "(docs/PAGED_KV.md); prefix hits then SCATTER pool "
+                        "rows host→device instead of remapping tables")
+    p.add_argument("--kv-block-tokens", type=int, default=16, metavar="T",
+                   help="paged KV: tokens per device pool block (rounded "
+                        "down to divide seq_len; also the radix directory's "
+                        "reuse granularity — docs/PAGED_KV.md)")
+    p.add_argument("--kv-pool-blocks", type=int, default=0, metavar="N",
+                   help="paged KV: device pool capacity in blocks (0 = auto: "
+                        "slots x blocks-per-context + headroom). Sizing it "
+                        "BELOW slots x contexts oversubscribes KV — longer "
+                        "contexts fit, pool pressure evicts/demotes the "
+                        "directory (docs/PAGED_KV.md)")
     p.add_argument("--max-queue", type=int, default=0, metavar="N",
                    help="admission control (--batch > 1 only): refuse new "
                         "requests with 503 + Retry-After once N are waiting "
@@ -1201,6 +1221,9 @@ def main(argv=None) -> None:
             prefix_cache_blocks=args.prefix_cache_blocks,
             prefix_block_tokens=args.prefix_cache_block_tokens,
             prefix_cache_q80=args.prefix_cache_q80,
+            paged_kv=not args.no_paged_kv,
+            kv_block_tokens=args.kv_block_tokens,
+            kv_pool_blocks=args.kv_pool_blocks,
             max_queue=args.max_queue, queue_ttl=args.queue_ttl,
             tenants=tenants,
             slo_ttft_interactive=args.slo_ttft_interactive,

@@ -4,7 +4,11 @@ The cross-request layer between request admission and the device KV cache:
 
 - `radix.py`    — token-block radix index (refcounts, LRU, hit accounting)
 - `block_pool.py` — bounded host block store (hot tier + optional Q80 tier)
+  + HostKVArena, the one RAM/memmap backend for every host-side KV spill
 - `prefix_cache.py` — the facade: lookup/insert/leases/eviction + metrics
+- `device_pool.py` — device-resident paged KV (docs/PAGED_KV.md): block
+  pool refcounts + the radix DIRECTORY over device blocks (zero-copy
+  remap hits, device→host demotion into the KVBlockPool tier)
 - `single_slot.py`  — Engine (api_server --batch 1) client, retiring NaiveCache
 
 BatchEngine integrates directly (runtime/batch_engine.py: admission seeding in
@@ -19,11 +23,14 @@ into every `cache.radix` importer.
 
 from __future__ import annotations
 
-__all__ = ["KVBlockPool", "PrefixCache", "PrefixLease", "RadixIndex",
+__all__ = ["DeviceKVPool", "HostKVArena", "KVBlockPool", "PagedPrefixCache",
+           "PrefixCache", "PrefixLease", "RadixIndex",
            "SingleSlotCache", "default_pool_blocks", "make_prefix_cache",
            "warn_degraded"]
 
-_LAZY = {"KVBlockPool": "block_pool", "PrefixCache": "prefix_cache",
+_LAZY = {"DeviceKVPool": "device_pool", "HostKVArena": "block_pool",
+         "KVBlockPool": "block_pool", "PagedPrefixCache": "device_pool",
+         "PrefixCache": "prefix_cache",
          "PrefixLease": "prefix_cache", "RadixIndex": "radix",
          "SingleSlotCache": "single_slot"}
 

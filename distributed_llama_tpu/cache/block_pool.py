@@ -1,5 +1,14 @@
 """Bounded host-side KV block store: F32/BF16 hot tier + optional Q80 cold tier.
 
+Also home to `HostKVArena` — the RAM-or-disc (memmap) K/V arena that backs
+every host-side KV spill in the repo: the long-context paged engine's
+authoritative store (runtime/paged_cache.py HostKVStore delegates its
+storage here) and, together with `KVBlockPool`, the device block pool's
+cold tier (cache/device_pool.py demotes evicted directory blocks into a
+KVBlockPool). One storage module, one cleanup discipline, one metric
+family — the pre-ISSUE-12 state had paged_cache.py carrying its own
+memmap + weakref-finalizer duplicate of this logic.
+
 Each block holds the committed (K, V) rows of `block_tokens` consecutive
 positions for every layer — shape (L, hk, block_tokens, hs) per side, exactly
 the slice a slot's contiguous (B, hk, S, hs) device cache rows scatter from /
@@ -28,7 +37,60 @@ import numpy as np
 
 from ..quants import QK, dequantize_q80, quantize_q80
 
-__all__ = ["KVBlockPool"]
+__all__ = ["HostKVArena", "KVBlockPool"]
+
+
+class HostKVArena:
+    """A (K, V) ndarray pair in host RAM ("host") or an np.memmap'd file
+    pair ("disc"), with the self-cleaning temp-directory discipline the
+    paged engine pioneered: a store whose directory WE created is removed
+    at GC-or-exit via weakref.finalize (never atexit — that would pin every
+    store for the process lifetime and leak multi-GB cache pairs across
+    repeated in-process engine constructions); a caller-supplied directory
+    is owner-kept. The one storage backend for every host-side KV spill
+    (module docstring)."""
+
+    def __init__(self, shape: tuple, dtype, *, storage: str = "host",
+                 directory: str | None = None,
+                 names: tuple[str, str] = ("key.cache", "value.cache")):
+        import os
+
+        assert storage in ("host", "disc"), storage
+        self.storage = storage
+        self.paths: tuple[str, str] | None = None
+        self._owned_dir: str | None = None
+        if storage == "disc":
+            import shutil
+            import tempfile
+            import weakref
+
+            if directory is None:
+                directory = tempfile.mkdtemp(prefix="dlt_kv_cache_")
+                self._owned_dir = directory
+                self._finalizer = weakref.finalize(
+                    self, shutil.rmtree, directory, ignore_errors=True)
+            os.makedirs(directory, exist_ok=True)
+            self.paths = (os.path.join(directory, names[0]),
+                          os.path.join(directory, names[1]))
+            self.k = np.memmap(self.paths[0], dtype=dtype, mode="w+",
+                               shape=shape)
+            self.v = np.memmap(self.paths[1], dtype=dtype, mode="w+",
+                               shape=shape)
+        else:
+            self.k = np.zeros(shape, dtype)
+            self.v = np.zeros(shape, dtype)
+
+    def cleanup(self) -> None:
+        """Delete the file pair + directory IF this arena created the
+        directory itself. Idempotent; detaches the GC/exit finalizer."""
+        if not self._owned_dir:
+            return
+        self._owned_dir = None
+        self.k = self.v = None  # drop the memmaps before unlinking
+        self._finalizer()
+
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
 
 
 class _Block:

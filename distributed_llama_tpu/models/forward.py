@@ -81,7 +81,8 @@ def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> 
 
 def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos,
                positions, axis_name, sp_axis_name, sp_size, use_pallas, compress,
-               window, deferred_write=False, prologue=False, paged_cold=None):
+               window, deferred_write=False, prologue=False, paged_cold=None,
+               block_tables=None, block_tokens=0, paged_kernel=False):
     """Sharded attention sub-block against the FULL stacked caches (L, B, hk, S, hs).
 
     Head counts in bp may be TP-local slices; the cache sequence axis may be sp-sharded
@@ -223,6 +224,48 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
         att = att.reshape(b, t, hq_local * hs).astype(x.dtype)
         attn_out = project_out(att)
         return attn_out, (k_t, v_t)  # caller commits into ring slots (mod R)
+    elif deferred_write and block_tables is not None:
+        # Device-resident paged KV (docs/PAGED_KV.md): the caches are a
+        # BLOCK POOL (L, N, hk, bt, hs) and each row's block table maps
+        # virtual positions to pool blocks. Two readers, same semantics:
+        # the Pallas kernel DMAs exactly the table's blocks pool→VMEM
+        # (scalar-prefetch index_map, ops/pallas_paged_attention.py); the
+        # XLA fallback gathers the table into the dense window layout and
+        # runs the SAME gqa_attention as the dense deferred branch — so on
+        # the CPU mesh paged logits are bit-identical to dense logits
+        # (the paged-vs-dense token-identity bar, tests/test_paged_kv.py).
+        # Writes commit post-scan through the same table (forward() below).
+        k_t = jnp.swapaxes(k, 1, 2).astype(kc.dtype)  # (B, hk, T, hs)
+        v_t = jnp.swapaxes(v, 1, 2).astype(vc.dtype)
+        w_total = block_tables.shape[1]
+        win = window or (w_total * block_tokens)
+        nb = min(-(-win // block_tokens), w_total)
+        if paged_kernel:
+            from ..ops.pallas_paged_attention import paged_attention
+
+            out = paged_attention(q.astype(jnp.float32), kc, vc, k_t, v_t,
+                                  block_tables, start_pos, layer_idx,
+                                  n_read=nb)
+            att = out.reshape(b, t, hq_local * hs).astype(x.dtype)
+        else:
+            from ..ops.pallas_paged_attention import paged_gather_kv
+
+            kw, vw = paged_gather_kv(kc, vc, layer_idx, block_tables, nb)
+            vwin = nb * block_tokens
+            slot = jnp.arange(vwin)
+            # same committed-rows masking (and sentinel arithmetic) as the
+            # dense per-row deferred branch below — a table entry past the
+            # row's committed length is scratch/garbage and masks out
+            slot_pos = jnp.where(slot[None, :] < start_pos[:, None],
+                                 slot[None, :], spec.seq_len + 1)  # (B, vwin)
+            key_pos = jnp.concatenate(
+                [slot_pos, start_pos[:, None] + jnp.arange(t)[None, :]],
+                axis=1)
+            att = gqa_attention(q, jnp.concatenate([kw, k_t], axis=2),
+                                jnp.concatenate([vw, v_t], axis=2),
+                                positions, key_positions=key_pos)
+        attn_out = project_out(att)
+        return attn_out, (k_t, v_t)  # new rows only; caller commits post-scan
     elif deferred_write:
         # deferred-write path: the caches are loop-INVARIANT inside the layer scan —
         # attention reads the window of COMMITTED rows (positions < start_pos) and
@@ -521,7 +564,8 @@ def _moe_ffn_expert_sharded(xb, bp, spec: ModelSpec, axis_name, use_pallas, comp
 
 def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions,
            axis_name, sp_axis_name, sp_size, use_pallas, compress, window,
-           kc_ro=None, vc_ro=None, prologue=False, paged_cold=None):
+           kc_ro=None, vc_ro=None, prologue=False, paged_cold=None,
+           block_tables=None, block_tokens=0, paged_kernel=False):
     """One transformer block as a scan step. Two cache disciplines:
 
     - in-scan (kc_ro is None): caches travel in the carry and are updated in place
@@ -540,7 +584,10 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
                                  positions, axis_name, sp_axis_name, sp_size,
                                  use_pallas, compress, window,
                                  deferred_write=deferred, prologue=prologue,
-                                 paged_cold=paged_cold)
+                                 paged_cold=paged_cold,
+                                 block_tables=block_tables,
+                                 block_tokens=block_tokens,
+                                 paged_kernel=paged_kernel)
     if not deferred:
         kc, vc = kvout
     if spec.arch_type == ArchType.GROK1:
@@ -568,7 +615,9 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             sp_axis_name: str | None = None, sp_size: int = 1,
             use_pallas: bool = False, compress_collectives: bool = False,
             attn_window: int | None = None, cache_write: str = "inscan",
-            fused_prologue: bool = False, paged_cold=None):
+            fused_prologue: bool = False, paged_cold=None,
+            block_tables=None, block_tokens: int = 0,
+            paged_kernel: bool = False):
     """Run T tokens through the model against the KV cache.
 
     tokens: (B, T) int32; k_cache/v_cache: (L, B, hk[/tp], S, hs); start_pos: scalar
@@ -630,6 +679,12 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
             "and a scalar start_pos")
         assert t <= k_cache.shape[3], (
             f"chunk {t} exceeds the {k_cache.shape[3]}-slot resident ring")
+    if block_tables is not None:
+        assert deferred and not sp_active and paged_cold is None, (
+            "device-resident paged KV requires the deferred discipline and "
+            "no sp sharding / host-spill paging")
+        assert block_tokens >= 1 and start_pos.ndim == 1, (
+            "paged KV needs block_tokens and per-row start_pos")
     # fused rmsnorm+quantize prologue (ops/pallas_prologue.py): single-row decode
     # only (the kernels take one activation row), opt-in via fused_prologue
     if fused_prologue:
@@ -645,14 +700,32 @@ def forward(params: dict[str, Any], spec: ModelSpec, rope: RopeTables,
                                  window=attn_window,
                                  kc_ro=k_cache if deferred else None,
                                  vc_ro=v_cache if deferred else None,
-                                 prologue=fused_prologue, paged_cold=paged_cold)
+                                 prologue=fused_prologue, paged_cold=paged_cold,
+                                 block_tables=block_tables,
+                                 block_tokens=block_tokens,
+                                 paged_kernel=paged_kernel)
     layer_ids = jnp.arange(spec.n_layers, dtype=jnp.int32)
     if deferred:
         x, (k_rows, v_rows) = jax.lax.scan(
             block_fn, x, (params["blocks"], layer_ids))
         # commit all layers' new rows in one write per cache: (L, B, hk, T, hs)
         # lands at [.., .., .., start_pos : start_pos+T, ..]
-        if paged_cold is not None:
+        if block_tables is not None:
+            # paged commit: position p of row b lands in pool block
+            # tables[b, p // bt] at offset p % bt — one scatter per cache,
+            # through the same table the read path consumed. Out-of-range
+            # positions cannot occur by scheduler invariant (coverage is
+            # ensured pre-dispatch; parked rows clamp below seq_len).
+            pos_bt = positions  # (B, T) absolute positions
+            blk = jnp.take_along_axis(
+                block_tables, jnp.minimum(pos_bt // block_tokens,
+                                          block_tables.shape[1] - 1), axis=1)
+            off = pos_bt % block_tokens  # (B, T)
+            k_cache = k_cache.at[:, blk, :, off, :].set(
+                jnp.transpose(k_rows, (1, 3, 0, 2, 4)))
+            v_cache = v_cache.at[:, blk, :, off, :].set(
+                jnp.transpose(v_rows, (1, 3, 0, 2, 4)))
+        elif paged_cold is not None:
             # ring commit: position p lands in slot p mod R (scatter — the
             # chunk may wrap the ring boundary). The rows being overwritten
             # need no flush: the HOST store is authoritative for every

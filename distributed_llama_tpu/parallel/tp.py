@@ -130,13 +130,20 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
                          attn_window: int | None = None,
                          cache_write: str = "inscan",
                          moe_sharding: str = "slice",
-                         fused_prologue: bool = False):
+                         fused_prologue: bool = False,
+                         kv_block_tokens: int = 0,
+                         paged_kernel: bool = False):
     """Build the jitted SPMD forward step over the mesh's tp axis.
 
     Returns fn(params, rope, tokens, k_cache, v_cache, start_pos) ->
     (logits, k_cache, v_cache). Cache buffers are donated (in-place update in HBM).
     attn_window statically bounds the cache positions attention reads (see
     models.forward.forward); callers must keep start_pos + T <= attn_window.
+
+    kv_block_tokens > 0 selects the device-resident paged KV layout
+    (docs/PAGED_KV.md): the caches are a (L, N, hk, bt, hs) block pool and
+    the returned fn takes a trailing per-row block-table argument —
+    fn(params, rope, tokens, k_cache, v_cache, start_pos, tables).
     """
     import jax.numpy as jnp
 
@@ -162,20 +169,49 @@ def make_sharded_forward(spec: ModelSpec, mesh: Mesh, params: dict[str, Any], *,
     tok_spec = P(AXIS_DP) if dp > 1 else P()
     pos_spec = P(AXIS_DP) if dp > 1 else P()
 
+    paged = kv_block_tokens > 0
+    if paged:
+        assert sp == 1 and dp == 1, "paged KV is tp-only (no sp/dp sharding)"
+        # pool layout (L, N, hk, bt, hs): heads stay on tp, blocks replicated
+        kv_spec = P(None, None, AXIS_TP)
     fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
                             attn_window=attn_window, cache_write=cache_write,
-                            fused_prologue=fused_prologue)
+                            fused_prologue=fused_prologue,
+                            block_tokens=kv_block_tokens,
+                            paged_kernel=paged_kernel)
     rope_type = spec.rope_type
+
+    from ..compat import shard_map
+
+    if paged:
+        def step(p, rope_cos, rope_sin, tokens, kc, vc, start_pos, tables):
+            rope = RopeTables(rope_cos, rope_sin, rope_type)
+            return fwd(p, rope=rope, tokens=tokens, k_cache=kc, v_cache=vc,
+                       start_pos=start_pos, block_tables=tables)
+
+        sharded = shard_map(
+            step, mesh=mesh,
+            in_specs=(param_specs, P(), P(), tok_spec, kv_spec, kv_spec,
+                      pos_spec, P()),
+            out_specs=(tok_spec, kv_spec, kv_spec),
+            check_vma=False,
+        )
+        donate = (4, 5) if donate_cache else ()
+        jitted = jax.jit(sharded, donate_argnums=donate)
+
+        def run(p, rope: RopeTables, tokens, kc, vc, start_pos, tables):
+            return jitted(p, rope.cos, rope.sin, tokens, kc, vc, start_pos,
+                          tables)
+
+        return run
 
     def step(p, rope_cos, rope_sin, tokens, kc, vc, start_pos):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
         return fwd(p, rope=rope, tokens=tokens, k_cache=kc, v_cache=vc,
                    start_pos=start_pos)
-
-    from ..compat import shard_map
 
     sharded = shard_map(
         step, mesh=mesh,

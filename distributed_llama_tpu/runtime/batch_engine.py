@@ -243,6 +243,18 @@ _WEDGE_FAILED = metrics.counter(
     "recovery (retriable: a durable router resumes them elsewhere)")
 
 
+# Donated single-block pool updates (docs/PAGED_KV.md copy-on-write and
+# cold promotion): an eager `pool.at[:, b].set(...)` would materialize a
+# whole new pool array per block touched — O(pool) HBM traffic and 2x peak
+# memory. Donating the pool lets XLA update the one block in place.
+import jax  # noqa: E402  (after the module docstring's import block)
+
+_pool_block_copy = jax.jit(lambda c, src, dst: c.at[:, dst].set(c[:, src]),
+                           donate_argnums=(0,))
+_pool_block_set = jax.jit(lambda c, dst, rows: c.at[:, dst].set(rows),
+                          donate_argnums=(0,))
+
+
 class _StaleEpoch(BaseException):
     """Raised inside an ABANDONED scheduler thread (recover_wedged bumped the
     engine epoch while this thread was stuck in a device call): the thread
@@ -331,6 +343,11 @@ class _Slot:
         # prefix-cache lease pinning the blocks this slot was seeded from
         # (released at _finish; shrunk when history is truncated)
         self.lease = None
+        # device-pool block table (paged KV, docs/PAGED_KV.md): pool block
+        # ids backing virtual positions [0, len(blocks)*bt); one pool ref
+        # held per entry. Retained across requests like `history` — the
+        # same-slot rewind's backing store.
+        self.blocks: list[int] = []
         self.admit_t = 0.0  # monotonic admission time (dispatch watchdog)
         # last_token is sampled/delivered but its KV not yet written: a
         # dispatch that fails AFTER _advance_row consumed next_token must not
@@ -410,6 +427,8 @@ class BatchEngine:
                  slo_ttft_interactive: float = 0.0,
                  slo_ttft_batch: float = 0.0,
                  slo_tpot_interactive: float = 0.0,
+                 paged_kv: bool = True, kv_block_tokens: int = 16,
+                 kv_pool_blocks: int = 0,
                  **engine_kw):
         from .engine import Engine
 
@@ -419,7 +438,44 @@ class BatchEngine:
             "continuous batching needs per-row cache positions, which the "
             "sequence-sharded (ring) cache does not support")
         self.slots_n = slots
-        self._eng = Engine(spec, params, tokenizer, batch=slots, **engine_kw)
+        # Device-resident paged KV (docs/PAGED_KV.md, default ON; the
+        # --no-paged-kv escape hatch reverts to the dense per-slot caches):
+        # KV lives in a (L, N, hk, bt, hs) device block pool, each slot
+        # carries a block table, and cross-request prefix reuse is a
+        # refcounted block-table REMAP — zero host→device KV bytes on a
+        # radix hit. A shared dense PrefixCache instance forces the dense
+        # layout (the caller asked for host-pool sharing semantics); the
+        # Engine gate below additionally drops it under sp/dp sharding or
+        # host/disc KV spill.
+        kv_pool_cfg = None
+        from ..cache import PrefixCache as _DensePC
+
+        if paged_kv and not isinstance(prefix_cache, _DensePC):
+            bt = max(int(kv_block_tokens), 1)
+            while bt > 1 and spec.seq_len % bt:
+                bt //= 2  # the parity gather wants bt | seq_len
+            w = spec.seq_len // bt
+            n_blocks = int(kv_pool_blocks) or (slots * w + slots + 1)
+            # floor: one full context + the scratch block + one spare, or
+            # no request could ever run to seq_len
+            kv_pool_cfg = (max(n_blocks, w + 2), bt)
+        self._eng = Engine(spec, params, tokenizer, batch=slots,
+                           kv_pool=kv_pool_cfg, **engine_kw)
+        self.kv_pool = None  # DeviceKVPool metadata (None = dense layout)
+        self._kv_bt = 0
+        if self._eng.kv_pool is not None:
+            from ..cache.device_pool import DeviceKVPool
+
+            n_blocks, self._kv_bt = self._eng.kv_pool
+            self.kv_pool = DeviceKVPool(n_blocks, self._kv_bt)
+            self._kv_w = spec.seq_len // self._kv_bt
+            self._tables_np = np.zeros((slots, self._kv_w), np.int32)
+            self._tables_dev = None  # rebuilt lazily after table edits
+        # admission seeding cost readout (bench.py shared-prefix columns):
+        # host→device KV bytes moved and wall time spent seeding slots —
+        # ~0 bytes on the paged path (remap), the full fetched span dense
+        self.seed_bytes = 0
+        self.seed_ms = 0.0
         # check the ENGINE's resolution (kwarg or DLT_PROLOGUE env) — warning on
         # the kwarg alone would miss the env route the flag help advertises
         if self._eng.fused_prologue and slots > 1:
@@ -515,10 +571,27 @@ class BatchEngine:
         _DISPATCH_AGE.set_function(self._dispatch_age)
         # Cross-request prefix cache (cache/): pass False to disable, True for
         # defaults, or a ready PrefixCache instance to share one across
-        # engines. Paged engines are excluded — their ring layout has no
-        # plain [0, n) row prefix to seed.
+        # engines. Host/disc-spill paged engines are excluded — their ring
+        # layout has no plain [0, n) row prefix to seed. In device-pool mode
+        # the cache is the radix DIRECTORY over device blocks
+        # (cache/device_pool.py): hits remap block tables instead of copying
+        # rows, and its cold tier is the same host KVBlockPool the dense
+        # cache used (one unified demotion path, docs/PAGED_KV.md).
         self.prefix_cache = None
-        if not self._eng.paged:
+        if self.kv_pool is not None:
+            if prefix_cache:
+                from ..cache import default_pool_blocks
+                from ..cache.device_pool import PagedPrefixCache
+
+                hk = self._eng.k_cache.shape[2]
+                cold = prefix_cache_blocks or default_pool_blocks(
+                    (spec.n_layers, slots, hk, spec.seq_len,
+                     spec.head_size),
+                    self._eng.k_cache.dtype.itemsize, self._kv_bt, slots)
+                self.prefix_cache = PagedPrefixCache(
+                    self.kv_pool, self._kv_bt, cold_blocks=cold,
+                    q80=prefix_cache_q80)
+        elif not self._eng.paged:
             from ..cache import make_prefix_cache
 
             self.prefix_cache = make_prefix_cache(
@@ -846,6 +919,9 @@ class BatchEngine:
                 if self.prefix_cache is not None and s.lease is not None:
                     self.prefix_cache.release(s.lease)
                     s.lease = None
+                if self.kv_pool is not None and s.blocks:
+                    self.kv_pool.decref(s.blocks)
+                    s.blocks = []
                 req = s.req
                 s.req = None
                 s.pending = []
@@ -881,6 +957,14 @@ class BatchEngine:
                 eng._steps.clear()
                 eng._decode_loops.clear()
                 eng.k_cache, eng.v_cache = eng._init_cache()
+                if self.kv_pool is not None:
+                    # fresh pool arrays: every allocation and directory
+                    # handle referenced the replaced buffers
+                    self.kv_pool.reset()
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.reset()
+                    self._tables_np[:] = 0
+                    self._tables_dev = None
             except Exception as e:
                 ok = False
                 print(f"🔴 backend re-initialization failed: {e!r}")
@@ -993,7 +1077,13 @@ class BatchEngine:
         best = max(free, key=common)
         rewind = common(best)
         reuse = rewind
-        if self.prefix_cache is not None:
+        if self.kv_pool is not None:
+            # paged admission (docs/PAGED_KV.md): the radix directory hit
+            # is a refcounted block-table remap, not a row copy — bind the
+            # request's context so the batch.prefix_seed span attributes
+            with reqctx.use(req.ctx):
+                reuse = self._paged_adopt(best, req, rewind, full)
+        elif self.prefix_cache is not None:
             # [0, reuse) is served by the slot's own resident rows; anything
             # the radix seed adds on top is counted as hit_tokens inside.
             # Cross-thread trace re-entry: the seed runs on the scheduler
@@ -1072,6 +1162,7 @@ class BatchEngine:
             return reuse
         eng = self._eng
         n = lease.tokens
+        t0 = time.perf_counter()
         try:
             with trace.span("batch.prefix_seed",
                             {"slot": slot.index, "tokens": n,
@@ -1093,10 +1184,235 @@ class BatchEngine:
 
             warn_degraded("seed", e)  # fall back to full prefill
             return reuse
+        # host→device KV bytes this admission moved (the scatter baseline
+        # the paged remap path eliminates — bench.py shared-prefix columns)
+        self.seed_bytes += int(rows.nbytes)
+        self.seed_ms += (time.perf_counter() - t0) * 1e3
         slot.lease = lease
         self.prefix_cache.mark_seeded(lease, n - reuse)
         _PREFIX_SEEDED.inc(n - reuse)
         return n
+
+    # ------------------------------------------------------------------
+    # device-resident paged KV (docs/PAGED_KV.md)
+    # ------------------------------------------------------------------
+
+    def _tables(self):
+        """Current (B, W) device block table; re-uploaded only after a table
+        edit (a few hundred BYTES of metadata — never KV rows)."""
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables_np)
+        return self._tables_dev
+
+    def _table_row(self, slot: _Slot) -> None:  # hot-path
+        """Rewrite one slot's table row from slot.blocks (filler entries
+        point at the scratch block, whose contents are never read)."""
+        row = self._tables_np[slot.index]
+        row[:] = 0
+        row[:len(slot.blocks)] = slot.blocks
+        self._tables_dev = None
+
+    def _paged_release_slot(self, slot: _Slot) -> None:
+        """Drop a slot's whole table (and the rewind stock it backs). The
+        committed full blocks live on through any directory references."""
+        if slot.blocks:
+            self.kv_pool.decref(slot.blocks)
+        slot.blocks = []
+        slot.history = []
+        slot.pos = 0
+        self._table_row(slot)
+
+    def _paged_alloc(self, n: int, exclude: _Slot | None = None) -> list[int]:
+        """Allocate n pool blocks, reclaiming directory/idle-slot stock
+        under pressure; raises KVPoolExhausted (request-scope) when the
+        pool genuinely cannot serve. `exclude` shields one slot from the
+        idle-slot reclaim tier — the ADOPTING slot looks idle (req is
+        bound only after _paged_adopt returns), and releasing it mid-adopt
+        would double-free the very blocks being rewired."""
+        ids = self.kv_pool.alloc(n)
+        if ids is None:
+            self._paged_reclaim(n, exclude=exclude)
+            ids = self.kv_pool.alloc(n)
+        if ids is None:
+            from ..cache.device_pool import KVPoolExhausted
+
+            raise KVPoolExhausted(
+                f"device KV pool exhausted: {n} block(s) needed, "
+                f"{self.kv_pool.free_blocks()} free after reclaim "
+                "(raise --kv-pool-blocks or admit fewer long contexts)")
+        return ids
+
+    def _paged_reclaim(self, need: int, exclude: _Slot | None = None) -> None:
+        """Free device blocks: demote/evict LRU unreferenced directory
+        nodes first (cold tier keeps the prefix servable), then drop idle
+        slots' retained rewind tables — their committed blocks survive via
+        the directory where it references them. `exclude` (see
+        _paged_alloc) is never released. Only the DEFICIT is reclaimed:
+        demoting `need` blocks when all but one are already free would
+        churn the directory (and its D2H copies) for nothing."""
+        deficit = need - self.kv_pool.free_blocks()
+        if deficit <= 0:
+            return
+        if self.prefix_cache is not None:
+            self.prefix_cache.reclaim(deficit, self._read_block)
+        if self.kv_pool.free_blocks() >= need:
+            return
+        for sl in self._slots:
+            if sl.req is None and sl.blocks and sl is not exclude:
+                self._paged_release_slot(sl)
+                if self.kv_pool.free_blocks() >= need:
+                    return
+
+    def _read_block(self, bid: int):
+        """Device→host copy of one pool block's rows (L, hk, bt, hs) — the
+        directory's demotion payload."""
+        eng = self._eng
+        return np.asarray(eng.k_cache[:, bid]), np.asarray(eng.v_cache[:, bid])
+
+    def _paged_ensure(self, slot: _Slot, upto: int) -> None:
+        """Grow the slot's table so every position < upto has a real block
+        (writes beyond coverage would land in the scratch block — fine for
+        parked garbage, fatal for committed rows)."""
+        need = -(-min(upto, self.spec.seq_len) // self._kv_bt) \
+            - len(slot.blocks)
+        if need <= 0:
+            return
+        ids = self._paged_alloc(need, exclude=slot)
+        start = len(slot.blocks)
+        slot.blocks.extend(ids)
+        self._tables_np[slot.index, start:start + need] = ids
+        self._tables_dev = None
+
+    def _paged_cow(self, slot: _Slot, lo: int, hi: int) -> None:
+        """Copy-on-write: make the blocks backing positions [lo, hi)
+        exclusively owned before the slot writes there. A shared block
+        (directory reference or a sibling slot's remap) gets a private
+        device-side copy — a D2D transfer, zero host bytes — so the shared
+        copy's committed rows can never be scribbled on."""
+        bt = self._kv_bt
+        eng = self._eng
+        for idx in range(lo // bt, min(-(-hi // bt), len(slot.blocks))):
+            bid = slot.blocks[idx]
+            if not self.kv_pool.shared(bid):
+                continue
+            nb = self._paged_alloc(1, exclude=slot)[0]
+            eng.k_cache = _pool_block_copy(eng.k_cache, bid, nb)
+            eng.v_cache = _pool_block_copy(eng.v_cache, bid, nb)
+            self.kv_pool.decref([bid])
+            self.kv_pool.note_cow()
+            slot.blocks[idx] = nb
+            self._tables_np[slot.index, idx] = nb
+            self._tables_dev = None
+
+    def _paged_adopt(self, slot: _Slot, req: BatchRequest, rewind: int,
+                     full: list[int]) -> int:
+        """Paged admission seeding: extend the same-slot rewind with a
+        DIRECTORY REMAP — shared full blocks are increfed into the slot's
+        table (zero bytes moved), a partially-used boundary block is CoW'd
+        so the slot can append, and cold (demoted) blocks pay exactly one
+        host→device promotion upload. Returns the reuse length (the prefill
+        start). Mirrors _seed_from_cache's degraded-mode contract: any
+        failure falls back to what the rewind already covered."""
+        from ..cache.device_pool import _REMAPPED, _SEED_BYTES
+
+        bt = self._kv_bt
+        pc = self.prefix_cache
+        eng = self._eng
+        t0 = time.perf_counter()
+        lease = None
+        if pc is not None:
+            pc.note_resident(rewind)
+            try:
+                faults.fire("batch.cache_seed", slot=slot.index)
+                lease = pc.lookup(full, cap=self.spec.seq_len - 1)
+                if lease is not None and lease.tokens <= rewind:
+                    pc.mark_unused(lease)
+                    lease = None
+            except Exception as e:
+                from ..cache import warn_degraded
+
+                warn_degraded("lookup", e)
+                lease = None
+        if lease is None:
+            # rewind-only: trim the retained table to the rewound prefix
+            # and make its boundary block writable (the first append lands
+            # at `rewind`, possibly inside a directory-shared block)
+            reuse = self._paged_adopt_rewind_only(slot, rewind)
+            self.seed_ms += (time.perf_counter() - t0) * 1e3
+            return reuse
+        n = lease.tokens
+        m, part = n // bt, n % bt
+        blocks: list[int] = []
+        moved = 0
+        try:
+            with trace.span("batch.prefix_seed",
+                            {"slot": slot.index, "tokens": n,
+                             "rewind": rewind, "remap": True}):
+                for i, node in enumerate(lease.nodes):
+                    tier, h = node.handle
+                    if tier == "cold":
+                        # promote: one host→device upload, then the
+                        # directory itself holds the device copy again.
+                        # promote() takes the DIRECTORY's own ref — drop
+                        # the allocation ref right after, or every
+                        # promotion leaks one never-freeable block
+                        k, v = pc.fetch_cold(h)
+                        nb = self._paged_alloc(1, exclude=slot)[0]
+                        eng.k_cache = _pool_block_set(
+                            eng.k_cache, nb, jnp.asarray(k, eng.dtype))
+                        eng.v_cache = _pool_block_set(
+                            eng.v_cache, nb, jnp.asarray(v, eng.dtype))
+                        moved += k.nbytes + v.nbytes
+                        pc.promote(node, nb)
+                        self.kv_pool.decref([nb])
+                        tier, h = node.handle
+                    if i < m:
+                        self.kv_pool.incref([h])
+                        blocks.append(h)
+                    else:
+                        # partial boundary block: private copy (D2D) the
+                        # slot can append into without touching the
+                        # directory's committed rows
+                        nb = self._paged_alloc(1, exclude=slot)[0]
+                        eng.k_cache = _pool_block_copy(eng.k_cache, h, nb)
+                        eng.v_cache = _pool_block_copy(eng.v_cache, h, nb)
+                        self.kv_pool.note_cow()
+                        blocks.append(nb)
+        except Exception as e:
+            if blocks:
+                self.kv_pool.decref(blocks)
+            pc.mark_unused(lease)
+            from ..cache import warn_degraded
+
+            warn_degraded("seed", e)  # fall back to the rewind stock
+            self.seed_ms += (time.perf_counter() - t0) * 1e3
+            return self._paged_adopt_rewind_only(slot, rewind)
+        old = slot.blocks
+        slot.blocks = blocks
+        if old:
+            self.kv_pool.decref(old)
+        self._table_row(slot)
+        slot.lease = lease
+        pc.mark_seeded(lease, n - rewind)
+        _PREFIX_SEEDED.inc(n - rewind)
+        _REMAPPED.inc(m)
+        if moved:
+            _SEED_BYTES.inc(moved)
+            self.seed_bytes += moved
+        self.seed_ms += (time.perf_counter() - t0) * 1e3
+        return n
+
+    def _paged_adopt_rewind_only(self, slot: _Slot, rewind: int) -> int:
+        """Degraded-seed fallback: keep only the rewound prefix's blocks."""
+        bt = self._kv_bt
+        keep = min(-(-rewind // bt), len(slot.blocks))
+        if keep < len(slot.blocks):
+            self.kv_pool.decref(slot.blocks[keep:])
+            del slot.blocks[keep:]
+            self._table_row(slot)
+        if rewind % bt:
+            self._paged_cow(slot, rewind, rewind + 1)
+        return rewind
 
     def _dispatched(self, kind: str, call):
         """Run one device dispatch with transient-fault retry: classify()
@@ -1158,10 +1474,16 @@ class BatchEngine:
         # neither donate the re-initialized backend's fresh cache arrays nor
         # rebind its stale outputs over them
         kc_in, vc_in = eng.k_cache, eng.v_cache
+        tables = self._tables() if self.kv_pool is not None else None
 
         def call():
-            logits, kc, vc = step(
-                eng.params, eng.rope, toks, kc_in, vc_in, start_pos)
+            if tables is not None:
+                logits, kc, vc = step(
+                    eng.params, eng.rope, toks, kc_in, vc_in, start_pos,
+                    tables)
+            else:
+                logits, kc, vc = step(
+                    eng.params, eng.rope, toks, kc_in, vc_in, start_pos)
             return np.asarray(logits), kc, vc
 
         out, eng.k_cache, eng.v_cache = self._dispatched(kind, call)
@@ -1222,7 +1544,17 @@ class BatchEngine:
             self._truncate_history(slot, slot.clamp_pos)
             slot.clamp_pos = None
         try:
-            if len(slot.history) >= pc.block_tokens:
+            if self.kv_pool is not None:
+                # zero-copy harvest: the directory takes REFS on the slot's
+                # committed full blocks — no device→host transfer at all
+                n = len(slot.history) // self._kv_bt
+                if n:
+                    with trace.span("batch.prefix_insert",
+                                    {"slot": slot.index,
+                                     "tokens": n * self._kv_bt,
+                                     "remap": True}):
+                        pc.insert_blocks(slot.history, slot.blocks[:n])
+            elif len(slot.history) >= pc.block_tokens:
                 eng = self._eng
 
                 def harvest(t0: int, t1: int):
@@ -1261,7 +1593,31 @@ class BatchEngine:
         for sl in self._slots:
             p = min(sl.pos, max(s - t, 0))
             if p < sl.pos:
+                if self.kv_pool is not None and sl.req is None:
+                    # paged idle slot: a clamped park would scribble into
+                    # possibly directory-shared tail blocks — drop the
+                    # rewind stock instead of CoW-ing for garbage (the
+                    # committed full blocks live on in the directory)
+                    self._paged_release_slot(sl)
+                    p = 0
+                    starts.append(p)
+                    continue
                 self._truncate_history(sl, p)
+                if self.kv_pool is not None:
+                    # the clamped scratch writes [p, p+t) must not land in
+                    # shared blocks (the directory's committed rows). A
+                    # pool that cannot even serve the CoW fails ONLY this
+                    # request — the slot then parks empty on the scratch
+                    # block like any idle row (callers re-filter for
+                    # reaped rows after _park_positions)
+                    try:
+                        self._paged_cow(sl, p, min(p + t, s))
+                    except Exception as e:
+                        if classify(e) != "request":
+                            raise
+                        self._fail_request(sl, e)
+                        self._paged_release_slot(sl)
+                        p = 0
             starts.append(p)
         return starts
 
@@ -1414,9 +1770,23 @@ class BatchEngine:
                 # tail must not be harvested (mirrors _harvest_into_cache)
                 self._truncate_history(slot, slot.clamp_pos)
                 slot.clamp_pos = None
-            eng = self._eng
-            harvest = (list(slot.history), eng.k_cache, eng.v_cache,
-                       slot.index)
+            if self.kv_pool is not None:
+                # paged: the harvest is a refcount, not a copy — run it
+                # inline (deferring would race the slot's reassignment
+                # CoW-ing or freeing the very blocks being inserted)
+                try:
+                    n = len(slot.history) // self._kv_bt
+                    if n:
+                        self.prefix_cache.insert_blocks(slot.history,
+                                                        slot.blocks[:n])
+                except Exception as e:
+                    from ..cache import warn_degraded
+
+                    warn_degraded("insert", e)
+            else:
+                eng = self._eng
+                harvest = (list(slot.history), eng.k_cache, eng.v_cache,
+                           slot.index)
         # nominal re-queue cost: the original admission already charged the
         # FULL request cost into the tenant's virtual time — charging the
         # remainder again would double-bill every preemption and erode the
@@ -1680,6 +2050,9 @@ class BatchEngine:
         piece = slot.pending[:chunk]
         t = len(piece)
         starts = self._park_positions(t)
+        if slot.req is None:  # reaped by a clamp-park CoW exhaustion
+            return
+        riders = [r for r in riders if r.req is not None]
         starts[slot.index] = slot.pos
         rows = [[0] * t for _ in self._slots]
         rows[slot.index] = piece
@@ -1689,6 +2062,22 @@ class BatchEngine:
             # overwrite (in-bounds by the chunk shrink above)
             starts[r.index] = r.pos
             rows[r.index] = [r.last_token] + [0] * (t - 1)
+        if self.kv_pool is not None:
+            # block coverage for every committed write this dispatch makes
+            # (the prefill chunk, each rider's one real token); scratch
+            # beyond coverage lands in the scratch block by design. A
+            # RIDER's exhaustion fails the rider, not the innocent prefill
+            # (the victim's own failure propagates and is attributed to it
+            # by _loop_once's request-scope handler)
+            self._paged_ensure(slot, slot.pos + t)
+            for r in riders[:]:
+                try:
+                    self._paged_ensure(r, r.pos + 1)
+                except Exception as e:
+                    if classify(e) != "request":
+                        raise
+                    self._fail_request(r, e)
+                    riders.remove(r)
         # the dispatch belongs to the prefilling request: bind its context
         # so the span (and any dispatch fault) carries its trace id
         with reqctx.use(slot.req.ctx), \
@@ -1730,6 +2119,17 @@ class BatchEngine:
         for slot in active[:]:
             if not self._advance_row(slot):
                 active.remove(slot)
+        if self.kv_pool is not None:
+            # every row's next write needs a real block behind it; a pool
+            # that cannot serve even after reclaim fails ONLY that request
+            for slot in active[:]:
+                try:
+                    self._paged_ensure(slot, slot.pos + 1)
+                except Exception as e:
+                    if classify(e) != "request":
+                        raise
+                    self._fail_request(slot, e)
+                    active.remove(slot)
         if not active:
             return
         if self.spec_k:
@@ -1758,6 +2158,10 @@ class BatchEngine:
         # single batched T=1 step: the admission-latency (and tail) path
         t0 = time.perf_counter()
         starts = self._park_positions(1)
+        # a clamp-park CoW under pool exhaustion may have reaped a row
+        active = [s for s in active if s.req is not None]
+        if not active:
+            return
         rows = [[0]] * self.slots_n
         for slot in active:
             starts[slot.index] = slot.pos
@@ -1791,7 +2195,9 @@ class BatchEngine:
                 compress_collectives=eng.compress, donate_cache=True,
                 attn_window=window, cache_write=eng.cache_write,
                 moe_sharding=eng.moe_sharding,
-                fused_prologue=eng.fused_prologue)
+                fused_prologue=eng.fused_prologue,
+                kv_block_tokens=self._kv_bt,
+                paged_kernel=eng.paged_kernel)
         return self._loops[key]
 
     def _verify_loop(self, t: int, mode: str, window: int | None):
@@ -1808,7 +2214,9 @@ class BatchEngine:
                 compress_collectives=eng.compress, donate_cache=True,
                 attn_window=window, cache_write=eng.cache_write,
                 moe_sharding=eng.moe_sharding,
-                fused_prologue=eng.fused_prologue)
+                fused_prologue=eng.fused_prologue,
+                kv_block_tokens=self._kv_bt,
+                paged_kernel=eng.paged_kernel)
         return self._loops[key]
 
     def _verify_block_for(self, t: int) -> int:
@@ -1874,7 +2282,23 @@ class BatchEngine:
         slots (the free-rollback discipline); the device carry is rewound to
         the frontier so a chained scan composes for any accept outcome."""
         faults.fire("batch.verify", rows=len(active), block=t)
+        if self.kv_pool is not None:
+            for slot in active[:]:
+                try:
+                    self._paged_ensure(slot, slot.pos + t)
+                except Exception as e:
+                    if classify(e) != "request":
+                        raise
+                    self._fail_request(slot, e)
+                    active.remove(slot)
+                    drafts.pop(slot.index, None)
+            if not active:
+                return
         starts = self._park_positions(t)
+        # a clamp-park CoW under pool exhaustion may have reaped a row
+        active = [s for s in active if s.req is not None]
+        if not active:
+            return
         ndraft = [-1] * self.slots_n  # -1 parks the row inside the block
         props = [[0] * t for _ in range(self.slots_n)]
         budget = [0] * self.slots_n  # per-row MAX emit (accept + correction)
@@ -1918,13 +2342,14 @@ class BatchEngine:
             _DISPATCH_GAP.observe(max(time.perf_counter() - self._gap_t, 0.0))
         t_issue = time.perf_counter()
         kc_in, vc_in = eng.k_cache, eng.v_cache  # same stale-epoch discipline
+        tables = self._tables() if self.kv_pool is not None else None
         with trace.span("batch.verify_issue",
                         {"block": t, "rows": len(rows),
                          "drafted": sum(max(n, 0) for n in ndraft)}):
             def call():
                 toks, acc, tok, pos, rng_out, kc, vc = loop(
                     eng.params, eng.rope, props, kc_in, vc_in,
-                    starts, rng, temps, topps, ndraft)
+                    starts, rng, temps, topps, ndraft, tables)
                 return toks, acc, tok, pos, rng_out, kc, vc
 
             (toks, acc, tok, pos, rng_out, eng.k_cache,
@@ -1960,7 +2385,22 @@ class BatchEngine:
         are overwritten by the slot's next real writes (free rollback). With
         pipelining, the NEXT super-step is chained from this one's device
         carry before delivery starts (_pipeline_advance)."""
+        if self.kv_pool is not None:
+            for slot in active[:]:
+                try:
+                    self._paged_ensure(slot, slot.pos + budgets[slot.index])
+                except Exception as e:
+                    if classify(e) != "request":
+                        raise
+                    self._fail_request(slot, e)
+                    active.remove(slot)
+            if not active:
+                return
         starts = self._park_positions(1)
+        # a clamp-park CoW under pool exhaustion may have reaped a row
+        active = [s for s in active if s.req is not None]
+        if not active:
+            return
         budget = [0] * self.slots_n
         rows: list[tuple[_Slot, BatchRequest]] = []
         for slot in active:
@@ -1988,6 +2428,21 @@ class BatchEngine:
                 # break the chain instead of extending it — the pipelined
                 # analog of the K -> 1 admission-latency drop
                 _PIPELINE_FLUSHES.labels(reason="admission").inc()
+                plan = None
+        if plan is not None and self.kv_pool is not None:
+            # the chained dispatch's speculative writes need block coverage
+            # (and clamped parks need exclusive blocks) BEFORE issue; a pool
+            # that cannot serve declines the chain instead of failing rows
+            rows, starts, budget, clamp = plan
+            try:
+                for slot, _req in rows:
+                    self._paged_ensure(slot, starts[slot.index]
+                                       + budget[slot.index])
+                for slot in clamp:
+                    self._paged_cow(slot, self.spec.seq_len - 1,
+                                    self.spec.seq_len)
+            except Exception:
+                _PIPELINE_FLUSHES.labels(reason="pool").inc()
                 plan = None
         if plan is not None:
             rows, starts, budget, clamp = plan
@@ -2113,13 +2568,14 @@ class BatchEngine:
             _DISPATCH_GAP.observe(0.0)  # chained: the device never went idle
         t_issue = time.perf_counter()
         kc_in, vc_in = eng.k_cache, eng.v_cache  # same stale-epoch discipline
+        tables = self._tables() if self.kv_pool is not None else None
         with trace.span("batch.super_step_issue",
                         {"k": k, "rows": len(rows),
                          "chained": chain is not None}):
             def call():
                 toks, tok, pos, rng_out, kc, vc = loop(
                     eng.params, eng.rope, tok_in, kc_in, vc_in,
-                    pos_in, rng_in, temps, topps, budget)
+                    pos_in, rng_in, temps, topps, budget, tables)
                 return toks, tok, pos, rng_out, kc, vc
 
             (toks, tok, pos, rng_out, eng.k_cache,

@@ -237,7 +237,9 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
                              attn_window: int | None = None,
                              cache_write: str = "inscan",
                              moe_sharding: str = "slice",
-                             fused_prologue: bool = False):
+                             fused_prologue: bool = False,
+                             kv_block_tokens: int = 0,
+                             paged_kernel: bool = False):
     """Batched K-step super-step: `lax.scan` over n_steps decode steps for ALL
     cache rows at once, sampling on device — the serving-path generalization of
     make_decode_loop (B=1) that converts the BatchEngine's hot loop from one
@@ -273,6 +275,12 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
 
     Under dp the row axis shards over the dp mesh axis (tokens/start_pos/rng/
     sampler params ride P(dp), like make_sharded_forward's batched step).
+
+    kv_block_tokens > 0 selects the device-resident paged KV layout
+    (docs/PAGED_KV.md): kc/vc are the (L, N, hk, bt, hs) block pool and the
+    built fn takes a trailing (B, W) block-table argument mapping each
+    row's virtual positions to pool blocks (loop-invariant across the scan;
+    the scheduler ensures coverage for every budgeted write pre-dispatch).
     """
     from ..parallel.mesh import AXIS_DP
 
@@ -281,8 +289,11 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
     sp = mesh.shape.get(AXIS_SP, 1)
     dp = mesh.shape.get(AXIS_DP, 1)
     assert sp == 1, "batched decode needs per-row cache positions (no sp ring)"
+    paged = kv_block_tokens > 0
+    assert not (paged and dp > 1), "paged KV is tp-only (no dp sharding)"
     param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
-    kv_spec = kv_cache_pspec_for_mesh(mesh)
+    kv_spec = (P(None, None, AXIS_TP) if paged
+               else kv_cache_pspec_for_mesh(mesh))
     rope_type = spec.rope_type
     seq_len = spec.seq_len
 
@@ -290,11 +301,13 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
                             sp_axis_name=None, sp_size=1, use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
                             attn_window=attn_window, cache_write=cache_write,
-                            fused_prologue=fused_prologue)
+                            fused_prologue=fused_prologue,
+                            block_tokens=kv_block_tokens,
+                            paged_kernel=paged_kernel)
 
     # hot-path: traced
     def loop(p, rope_cos, rope_sin, tokens, kc, vc, start_pos, rng_hi, rng_lo,
-             temperature, topp, budget):
+             temperature, topp, budget, tables):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
 
         def step(carry, i):
@@ -305,7 +318,8 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
             # and the row's next real decode overwrites it
             step_pos = jnp.where(live, pos, jnp.minimum(pos, seq_len - 1))
             logits, kc, vc = fwd(p, rope=rope, tokens=tok[:, None],
-                                 k_cache=kc, v_cache=vc, start_pos=step_pos)
+                                 k_cache=kc, v_cache=vc, start_pos=step_pos,
+                                 block_tables=tables if paged else None)
             rows = logits[:, -1].astype(jnp.float32)  # (B, vocab)
             if mode == "greedy":
                 nxt = jnp.argmax(rows, axis=-1).astype(jnp.int32)
@@ -332,7 +346,7 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
     sharded = shard_map(
         loop, mesh=mesh,
         in_specs=(param_specs, P(), P(), row, kv_spec, kv_spec, row, row, row,
-                  row, row, row),
+                  row, row, row, P()),
         out_specs=(toks_out, row, row, row, row, kv_spec, kv_spec),
         check_vma=False,
     )
@@ -341,14 +355,17 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
 
     # hot-path
     def run(p, rope: RopeTables, tokens, kc, vc, start_pos, rng, temperature,
-            topp, budget):
+            topp, budget, tables=None):
         faults.fire("device_loop.batched_dispatch", n_steps=n_steps)
         rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
+        if tables is None:
+            tables = jnp.zeros((rng.shape[0], 1), jnp.int32)  # dense: unused
         toks, tok, pos, sh, sl, kc, vc = jitted(
             p, rope.cos, rope.sin, jnp.asarray(tokens, jnp.int32), kc, vc,
             jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
             jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(topp, jnp.float32), jnp.asarray(budget, jnp.int32))
+            jnp.asarray(topp, jnp.float32), jnp.asarray(budget, jnp.int32),
+            jnp.asarray(tables, jnp.int32))
         return toks, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc
 
     return run
@@ -362,7 +379,9 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
                              attn_window: int | None = None,
                              cache_write: str = "inscan",
                              moe_sharding: str = "slice",
-                             fused_prologue: bool = False):
+                             fused_prologue: bool = False,
+                             kv_block_tokens: int = 0,
+                             paged_kernel: bool = False):
     """Batched draft-verify super-step: ONE (B, T=block) forward ingests each
     row's proposal block and on-device acceptance turns it into up to T
     tokens per row — the speculative-decoding counterpart of
@@ -406,24 +425,30 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
     sp = mesh.shape.get(AXIS_SP, 1)
     dp = mesh.shape.get(AXIS_DP, 1)
     assert sp == 1, "batched verify needs per-row cache positions (no sp ring)"
+    paged = kv_block_tokens > 0
+    assert not (paged and dp > 1), "paged KV is tp-only (no dp sharding)"
     param_specs = _expand_pspec_tree(params, param_pspecs(params, moe_sharding))
-    kv_spec = kv_cache_pspec_for_mesh(mesh)
+    kv_spec = (P(None, None, AXIS_TP) if paged
+               else kv_cache_pspec_for_mesh(mesh))
     rope_type = spec.rope_type
 
     fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
                             sp_axis_name=None, sp_size=1, use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
                             attn_window=attn_window, cache_write=cache_write,
-                            fused_prologue=fused_prologue)
+                            fused_prologue=fused_prologue,
+                            block_tokens=kv_block_tokens,
+                            paged_kernel=paged_kernel)
 
     # hot-path: traced
     def loop(p, rope_cos, rope_sin, proposals, kc, vc, start_pos, rng_hi,
-             rng_lo, temperature, topp, ndraft):
+             rng_lo, temperature, topp, ndraft, tables):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
         b = proposals.shape[0]
         live = ndraft >= 0  # (B,)
         logits, kc, vc = fwd(p, rope=rope, tokens=proposals, k_cache=kc,
-                             v_cache=vc, start_pos=start_pos)
+                             v_cache=vc, start_pos=start_pos,
+                             block_tables=tables if paged else None)
         rows = logits.astype(jnp.float32)  # (B, T, vocab)
         if mode == "greedy":
             targets = jnp.argmax(rows, axis=-1).astype(jnp.int32)  # (B, T)
@@ -468,7 +493,7 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
     sharded = shard_map(
         loop, mesh=mesh,
         in_specs=(param_specs, P(), P(), mat, kv_spec, kv_spec, row, row, row,
-                  row, row, row),
+                  row, row, row, P()),
         out_specs=(toks_out, row, row, row, row, row, kv_spec, kv_spec),
         check_vma=False,
     )
@@ -477,14 +502,17 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
 
     # hot-path
     def run(p, rope: RopeTables, proposals, kc, vc, start_pos, rng,
-            temperature, topp, ndraft):
+            temperature, topp, ndraft, tables=None):
         faults.fire("device_loop.verify_dispatch", block=block)
         rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
+        if tables is None:
+            tables = jnp.zeros((rng.shape[0], 1), jnp.int32)  # dense: unused
         toks, acc, tok, pos, sh, sl, kc, vc = jitted(
             p, rope.cos, rope.sin, jnp.asarray(proposals, jnp.int32), kc, vc,
             jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
             jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(topp, jnp.float32), jnp.asarray(ndraft, jnp.int32))
+            jnp.asarray(topp, jnp.float32), jnp.asarray(ndraft, jnp.int32),
+            jnp.asarray(tables, jnp.int32))
         return toks, acc, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc
 
     return run

@@ -152,7 +152,9 @@ class Engine:
                  prefill_kernel: bool | None = None,
                  kv_cache_storage: str | None = None,
                  kv_cache_resident: int = 1024,
-                 kv_cache_dir: str | None = None):
+                 kv_cache_dir: str | None = None,
+                 kv_pool: tuple[int, int] | None = None,
+                 paged_kernel: bool | None = None):
         self.spec = spec
         self.tokenizer = tokenizer
         on_tpu = jax.default_backend() == "tpu"
@@ -175,6 +177,32 @@ class Engine:
                       and spec.seq_len > self.kv_resident)
         if self.paged and tp is None:
             tp = 1  # paged mode is single-chip; don't let the mesh grab every device
+        # Device-resident paged KV (docs/PAGED_KV.md): kv_pool=(n_blocks,
+        # block_tokens) replaces the contiguous per-slot caches with a
+        # (L, N, hk, bt, hs) block pool + per-row block tables (BatchEngine
+        # owns the tables/refcounts; this engine allocates the arrays and
+        # builds table-aware step programs). Excluded combinations fall
+        # back to the dense layout here — ONE gate for every caller.
+        if kv_pool is not None and (self.paged or sp > 1 or dp > 1):
+            import sys
+
+            print("💡 device-resident paged KV disabled: incompatible with "
+                  + ("host/disc KV paging" if self.paged else "sp/dp sharding")
+                  + " — using the dense contiguous cache layout",
+                  file=sys.stderr)
+            kv_pool = None
+        self.kv_pool = kv_pool
+        if paged_kernel is None:
+            import os
+
+            # tri-state: explicit env wins, unset defers to the use_pallas
+            # resolution below (TPU + quantized weights → kernel on)
+            env = os.environ.get("DLT_PAGED_KERNEL", "").lower()
+            if env in ("1", "true", "yes", "interp"):
+                paged_kernel = True
+            elif env in ("0", "false", "no"):
+                paged_kernel = False
+        self._paged_kernel_req = paged_kernel  # resolved after use_pallas
         if pod:
             # multi-host job: mesh over EVERY chip in the job (the SPMD replacement
             # for the reference's worker fleet, dllama.cpp:205-221). Caller must have
@@ -231,6 +259,13 @@ class Engine:
         self.prefill_kernel = prefill_kernel and self.use_pallas
         if self.prefill_kernel:
             self.use_pallas = "all"  # qmatmul's M>1 kernel opt-in
+        # paged-attention kernel gate (ops/pallas_paged_attention.py):
+        # explicit request (kwarg / DLT_PAGED_KERNEL) wins; default follows
+        # use_pallas (TPU + quantized weights). CPU tests force it on via
+        # the env knob — the kernel then runs in interpret mode.
+        self.paged_kernel = bool(
+            self._paged_kernel_req if self._paged_kernel_req is not None
+            else self.use_pallas) and self.kv_pool is not None
         if self.use_pallas:
             params = prepare_for_pallas(params, self.tp,
                                         moe_sharding=self.moe_sharding,
@@ -309,6 +344,22 @@ class Engine:
                     use_pallas=self.use_pallas,
                     fused_prologue=self.fused_prologue)
             return self._steps["paged"]
+        if self.kv_pool is not None:
+            # table-aware step (docs/PAGED_KV.md): same window buckets, one
+            # extra (B, W) block-table argument — keyed apart from the dense
+            # programs so the compile manifest tracks them separately
+            key = ("pagedkv", window)
+            if key not in self._steps:
+                self._steps[key] = make_sharded_forward(
+                    self.spec, self.mesh, self.params, dtype=self.dtype,
+                    use_pallas=self.use_pallas,
+                    compress_collectives=self.compress,
+                    donate_cache=True, attn_window=window,
+                    cache_write="deferred", moe_sharding=self.moe_sharding,
+                    fused_prologue=self.fused_prologue,
+                    kv_block_tokens=self.kv_pool[1],
+                    paged_kernel=self.paged_kernel)
+            return self._steps[key]
         if window not in self._steps:
             self._steps[window] = make_sharded_forward(
                 self.spec, self.mesh, self.params, dtype=self.dtype,
@@ -342,6 +393,22 @@ class Engine:
 
             return init_ring_cache(self.spec, self.kv_resident, batch=1,
                                    dtype=self.dtype)
+        if self.kv_pool is not None:
+            # device block pool (docs/PAGED_KV.md): (L, N, hk, bt, hs), kv
+            # heads sharded over tp like the dense cache's head axis
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.sharding import effective_kv_heads
+            from ..parallel.mesh import AXIS_TP as _TP
+
+            n_blocks, bt = self.kv_pool
+            hk = effective_kv_heads(self.spec, self.tp)
+            shape = (self.spec.n_layers, n_blocks, hk, bt,
+                     self.spec.head_size)
+            sh = NamedSharding(self.mesh, P(None, None, _TP))
+            return (jax.device_put(jnp.zeros(shape, self.dtype), sh),
+                    jax.device_put(jnp.zeros(shape, self.dtype), sh))
         from ..parallel.tp import init_sharded_kv_cache
 
         return init_sharded_kv_cache(self.spec, self.mesh, batch=self.batch,
@@ -400,6 +467,16 @@ class Engine:
                 self.v_cache = jnp.asarray(vr, self.dtype)
         self.pos = pos
 
+    def _trace_pos_args(self):
+        """Trailing step args for collective-traffic tracing: start_pos
+        (plus a zero block table in device-pool mode, where the step is
+        table-aware and start_pos is per-row)."""
+        if self.kv_pool is not None:
+            w = -(-self.spec.seq_len // self.kv_pool[1])
+            return (jnp.zeros((self.batch,), jnp.int32),
+                    jnp.zeros((self.batch, w), jnp.int32))
+        return (self._pos_arg(0),)
+
     def _pos_arg(self, pos):
         """start_pos step argument: scalar normally, per-row (B,) under dp sharding
         (the dp in_spec shards the row axis, so a scalar can't be passed)."""
@@ -420,7 +497,7 @@ class Engine:
             tokens = jnp.zeros((self.batch, 1), jnp.int32)
             closed = jax.make_jaxpr(self._step)(
                 self.params, self.rope, tokens, self.k_cache, self.v_cache,
-                self._pos_arg(0))
+                *self._trace_pos_args())
             self._measured_traffic = jaxpr_collective_traffic(
                 closed, dict(self.mesh.shape))
             from ..parallel.hlo_stats import publish_traffic
@@ -446,7 +523,7 @@ class Engine:
         tokens = jnp.zeros((self.batch, 1), jnp.int32)
         lowered = jax.jit(self._step).lower(
             self.params, self.rope, tokens, self.k_cache, self.v_cache,
-            self._pos_arg(0))
+            *self._trace_pos_args())
         hlo = lowered.compile().as_text()
         self._compiled_traffic = collective_traffic(hlo, self.tp * self.sp)
         return self._compiled_traffic

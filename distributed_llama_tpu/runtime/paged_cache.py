@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -59,64 +58,54 @@ _COLD_BYTES = metrics.counter(
 class HostKVStore:
     """Authoritative full-context KV store on host RAM ("host") or an
     np.memmap'd file pair ("disc"). Layout (L, B, hk, S, hs), same axis order
-    as the device caches."""
+    as the device caches.
+
+    Storage (allocation, memmap files, owned-temp-dir weakref cleanup) is
+    delegated to cache/block_pool.HostKVArena — the ONE host-spill backend
+    (ISSUE 12 satellite: this module previously carried its own duplicate
+    of that logic); this class keeps only the paged-attention semantics
+    (append discipline + the per-layer cold-attention callback)."""
 
     def __init__(self, spec: ModelSpec, resident: int, *, batch: int = 1,
                  storage: str = "host", directory: str | None = None,
                  dtype=np.float32):
-        assert storage in ("host", "disc"), storage
+        from ..cache.block_pool import HostKVArena
+
         self.spec = spec
         self.resident = resident
         self.storage = storage
         shape = (spec.n_layers, batch, spec.n_kv_heads, spec.seq_len,
                  spec.head_size)
-        self.paths: tuple[str, str] | None = None
-        self._owned_dir: str | None = None
-        if storage == "disc":
-            import tempfile
-
-            if directory is None:
-                # we created it, we clean it up: each 7B/16k run would
-                # otherwise leak a multi-GB key/value.cache pair into /tmp.
-                # A caller-supplied directory is owner-kept (the reference's
-                # cache files persist too, utils.cpp:50-67).
-                # weakref.finalize, NOT atexit.register(self.cleanup): atexit
-                # would pin every disc-mode store for the process lifetime, so
-                # repeated in-process engine construction (tests, notebooks,
-                # server restarts) accumulates multi-GB cache pairs until
-                # interpreter exit. The finalizer runs at GC of the store OR
-                # at exit, whichever comes first, and holds no reference to
-                # self (only to the directory path).
-                import shutil
-                import weakref
-
-                directory = tempfile.mkdtemp(prefix="dlt_kv_cache_")
-                self._owned_dir = directory
-                self._finalizer = weakref.finalize(
-                    self, shutil.rmtree, directory, ignore_errors=True)
-            os.makedirs(directory, exist_ok=True)
-            self.paths = (os.path.join(directory, "key.cache"),
-                          os.path.join(directory, "value.cache"))
-            self.k = np.memmap(self.paths[0], dtype=dtype, mode="w+", shape=shape)
-            self.v = np.memmap(self.paths[1], dtype=dtype, mode="w+", shape=shape)
-        else:
-            self.k = np.zeros(shape, dtype)
-            self.v = np.zeros(shape, dtype)
+        self._arena = HostKVArena(shape, dtype, storage=storage,
+                                  directory=directory)
         _RESIDENT.set(resident)
         _STORE_BYTES.set(self.nbytes())
 
+    # storage facade: existing callers (engine.py seek/append paths, tests)
+    # read .k/.v/.paths directly — keep them as live views of the arena
+    @property
+    def k(self):
+        return self._arena.k
+
+    @property
+    def v(self):
+        return self._arena.v
+
+    @property
+    def paths(self):
+        return self._arena.paths
+
+    @property
+    def _owned_dir(self):
+        return self._arena._owned_dir
+
     def cleanup(self) -> None:
-        """Delete the cache file pair and its directory IF this store created
-        the directory itself (mkdtemp default). Idempotent; also detaches the
-        GC/exit finalizer so it cannot run twice."""
-        if not self._owned_dir:
-            return
-        self._owned_dir = None
-        self.k = self.v = None  # drop the memmaps before unlinking
-        self._finalizer()
+        """Delete the cache file pair and its directory IF the arena created
+        the directory itself (mkdtemp default). Idempotent."""
+        self._arena.cleanup()
 
     def nbytes(self) -> int:
-        return self.k.nbytes + self.v.nbytes
+        return self._arena.nbytes()
 
     def append(self, k_rows: np.ndarray, v_rows: np.ndarray, pos: int) -> None:
         """Write the step's new rows (L, B, hk, T, hs) at positions

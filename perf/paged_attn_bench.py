@@ -1,0 +1,167 @@
+"""Paged-attention kernel microbench + parity oracle (ISSUE 12 satellite).
+
+Measures achieved GB/s of ops/pallas_paged_attention.paged_attention at the
+two production shapes — decode (T=1, the K-step scan's per-step read) and
+speculative verify (T=1+k) — against the bytes the kernel must move per
+call (the table's KV blocks + the chunk), and checks three parities:
+
+- XLA-vs-dense BIT-EXACTNESS: paged_attention_xla (gather + gqa_attention)
+  must equal the dense contiguous-window gqa_attention to the last bit when
+  the gathered width equals the dense window — the structural property the
+  paged engine's token-identity rests on (tests/test_paged_kv.py).
+- kernel-vs-oracle numeric parity: the Pallas kernel's blockwise online
+  softmax against the one-shot XLA softmax, gated at a tight f32 tolerance.
+- greedy-pick agreement: argmax over a projected vocab row must match —
+  the token-level consequence of the numeric gap staying far below logit
+  spacing.
+
+CPU runs use interpret mode (correctness numbers only; GB/s on interpret
+mode measures the interpreter, and the JSON says so). On TPU, append the
+result row to a perf/r*_hw_results.jsonl-style artifact with --json.
+
+Usage: python perf/paged_attn_bench.py [--json out.json] [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _mk(rng, shape):
+    import jax.numpy as jnp
+
+    return jnp.asarray(rng.normal(size=shape).astype(np.float32))
+
+
+def bench_shape(t: int, *, L=8, N=64, hk=8, g=4, bt=64, hs=128, B=4,
+                iters=20, interpret=None, seed=0):
+    """One (decode or verify) shape: returns the parity + GB/s row."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llama_tpu.ops.attention import gqa_attention
+    from distributed_llama_tpu.ops.pallas_paged_attention import (
+        paged_attention, paged_attention_xla)
+
+    rng = np.random.default_rng(seed)
+    hq = hk * g
+    kc = _mk(rng, (L, N, hk, bt, hs))
+    vc = _mk(rng, (L, N, hk, bt, hs))
+    q = _mk(rng, (B, t, hq, hs))
+    kn = _mk(rng, (B, hk, t, hs))
+    vn = _mk(rng, (B, hk, t, hs))
+    nb = (N - 1) // B  # read blocks per row (disjoint tables, block 0 scratch)
+    tables = np.zeros((B, nb), np.int32)
+    ids = np.arange(1, B * nb + 1)
+    rng.shuffle(ids)
+    tables[:] = ids.reshape(B, nb)
+    tables = jnp.asarray(tables)
+    lengths = jnp.asarray(
+        rng.integers(bt, nb * bt + 1, size=B).astype(np.int32))
+    layer = min(3, L - 1)
+
+    out_k = paged_attention(q, kc, vc, kn, vn, tables, lengths, layer,
+                            n_read=nb, interpret=interpret)
+    out_x = paged_attention_xla(q, kc, vc, kn, vn, tables, lengths, layer,
+                                n_read=nb)
+    kernel_max_abs = float(jnp.max(jnp.abs(out_k - out_x)))
+
+    # XLA-vs-dense bit-exactness: materialize the virtual contiguous cache
+    # and run the dense deferred-window computation (same masks/sentinels)
+    kl = np.asarray(kc)[layer]
+    vl = np.asarray(vc)[layer]
+    tbl = np.asarray(tables)
+    kwin = np.stack([kl[tbl[b]].transpose(1, 0, 2, 3).reshape(
+        hk, nb * bt, hs) for b in range(B)])
+    vwin = np.stack([vl[tbl[b]].transpose(1, 0, 2, 3).reshape(
+        hk, nb * bt, hs) for b in range(B)])
+    win = nb * bt
+    slot = np.arange(win)
+    ln = np.asarray(lengths)
+    slot_pos = np.where(slot[None, :] < ln[:, None], slot[None, :], win + 1)
+    key_pos = np.concatenate([slot_pos, ln[:, None] + np.arange(t)[None, :]],
+                             axis=1)
+    positions = ln[:, None] + np.arange(t, dtype=np.int32)[None, :]
+    dense = gqa_attention(
+        q, jnp.concatenate([jnp.asarray(kwin), kn], axis=2),
+        jnp.concatenate([jnp.asarray(vwin), vn], axis=2),
+        jnp.asarray(positions), key_positions=jnp.asarray(key_pos))
+    xla_vs_dense_bits = bool(jnp.array_equal(
+        out_x.reshape(B, t, hq * hs).astype(dense.dtype), dense))
+
+    # greedy-pick agreement through a projection head
+    wproj = _mk(rng, (hs * hq, 512))
+    pick_k = jnp.argmax(out_k.reshape(B, t, hq * hs) @ wproj, axis=-1)
+    pick_x = jnp.argmax(out_x.reshape(B, t, hq * hs) @ wproj, axis=-1)
+    greedy_agree = bool(jnp.array_equal(pick_k, pick_x))
+
+    # timing: bytes = the KV blocks the table forces through HBM + chunk
+    fn = jax.jit(lambda *a: paged_attention(*a, n_read=nb,
+                                            interpret=interpret))
+    args = (q, kc, vc, kn, vn, tables, lengths, layer)
+    fn(*args)[0].block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    itemsize = np.dtype(np.float32).itemsize
+    bytes_moved = 2 * B * nb * hk * bt * hs * itemsize \
+        + 2 * B * hk * t * hs * itemsize
+    import jax as _jax
+
+    return {
+        "shape": "decode_t1" if t == 1 else f"verify_t{t}",
+        "B": B, "T": t, "layers_pool": L, "pool_blocks": N, "hk": hk,
+        "g": g, "block_tokens": bt, "head_size": hs, "read_blocks": nb,
+        "kernel_max_abs_err": kernel_max_abs,
+        "xla_vs_dense_bit_exact": xla_vs_dense_bits,
+        "greedy_pick_agree": greedy_agree,
+        "ms_per_call": round(dt * 1e3, 4),
+        "achieved_gbps": round(bytes_moved / dt / 1e9, 2),
+        "bytes_per_call": bytes_moved,
+        "backend": _jax.default_backend(),
+        "interpret": bool(interpret if interpret is not None
+                          else _jax.default_backend() != "tpu"),
+    }
+
+
+def run(iters: int = 20, small: bool = False, interpret=None):
+    kw = dict(iters=iters)
+    if small:  # tier-1 smoke geometry: seconds, not minutes, on CPU
+        kw.update(L=2, N=12, hk=2, g=2, bt=8, hs=16, B=2, iters=3)
+    rows = [bench_shape(1, **kw), bench_shape(5, **kw)]
+    for r in rows:
+        assert r["xla_vs_dense_bit_exact"], (
+            "paged gather path diverged bitwise from the dense window path")
+        assert r["kernel_max_abs_err"] < 2e-5, r["kernel_max_abs_err"]
+        assert r["greedy_pick_agree"], "kernel numeric gap flipped an argmax"
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", metavar="OUT", default=None)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--small", action="store_true",
+                    help="tiny smoke geometry (the tier-1 gate's shapes)")
+    args = ap.parse_args(argv)
+    rows = run(iters=args.iters, small=args.small)
+    out = {"bench": "paged_attention", "results": rows}
+    print(json.dumps(out, indent=2))
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(out, fh, indent=2)
+            fh.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

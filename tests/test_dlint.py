@@ -97,6 +97,33 @@ def test_compile_manifest_gate_holds_and_catches_injection():
             eng.close()
     findings = compile_audit.diff_manifest(audit.manifest(), pinned)
     assert findings, "gate failed to detect the injected shape bucket"
-    assert any("batched_scan[k=6,mode=greedy,window=None]" in f.message
-               for f in findings), [f.message for f in findings]
+    assert any("batched_scan[k=6,mode=greedy,window=None,paged=16]"
+               in f.message for f in findings), [f.message for f in findings]
     assert all(f.rule == "compile-manifest" for f in findings)
+
+
+def test_compile_manifest_catches_block_table_shape_creep():
+    """ISSUE 12 satellite: block-table shapes must be padded/bucketed so
+    per-request table growth never mints a fresh XLA lowering. Inject a
+    dispatch whose table widened by one entry (the bug a per-request table
+    shape would cause) through the SAME record path real dispatches hit —
+    the gate must fail naming the offending cache key and the drifted
+    signature."""
+    import numpy as np
+
+    from distributed_llama_tpu.analysis import compile_audit
+
+    pinned = compile_audit.load_manifest()
+    assert pinned is not None
+    key = "batched_scan[k=4,mode=greedy,window=None,paged=16]"
+    good = pinned["programs"][key]["signatures"][0]
+    audit = compile_audit.CompileAudit()
+    audit.record_call(key, (np.zeros((2, 5), np.int32),))  # table grew 4 -> 5
+    findings = compile_audit.diff_manifest(audit.manifest(), pinned)
+    assert findings and all(f.rule == "compile-manifest" for f in findings)
+    msg = findings[0].message
+    assert key in msg and "int32(2, 5)" in msg, msg
+    # the pinned width stays clean through the same path
+    clean_audit = compile_audit.CompileAudit()
+    clean_audit.programs[key] = {"builds": 0, "signatures": {good}}
+    assert compile_audit.diff_manifest(clean_audit.manifest(), pinned) == []

@@ -1,0 +1,440 @@
+"""Device-resident paged KV tests (ISSUE 12, docs/PAGED_KV.md).
+
+Load-bearing properties:
+- pool refcount/alloc/CoW metadata vs a brute-force oracle;
+- directory remap/demote/promote lifecycle (zero-copy hits, cold uploads);
+- token identity PAGED vs DENSE on the CPU mesh — greedy AND
+  seeded-stochastic, speculative verify, pipelined chains — resting on the
+  gather path's bit-exactness with the dense window computation;
+- durable-resume admissions over remapped blocks;
+- clamped parks copy-on-write instead of corrupting directory blocks;
+- pool exhaustion fails only the starving request (scheduler survives);
+- the Pallas kernel (interpret mode) serves the same tokens;
+- the perf/paged_attn_bench.py parity gate (tier-1 smoke).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.cache.device_pool import (DeviceKVPool,
+                                                     KVPoolExhausted,
+                                                     PagedPrefixCache,
+                                                     SCRATCH_BLOCK)
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+from distributed_llama_tpu.runtime.sampler import Sampler
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "perf"))
+
+
+def _spec(seq_len=128):
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=seq_len, rope_type=RopeType.LLAMA).resolved()
+
+
+def _settle(pred, timeout=10):
+    t0 = time.time()
+    while not pred() and time.time() - t0 < timeout:
+        time.sleep(0.01)
+    assert pred()
+
+
+# ------------------------------------------------------------------ pool
+
+
+def test_pool_refcount_property_vs_oracle():
+    """Random alloc/incref/decref interleavings against a dict oracle:
+    conservation (allocated + free == capacity - scratch), refcount
+    equality, no double-free, scratch never allocated."""
+    rng = np.random.default_rng(7)
+    pool = DeviceKVPool(24, 8)
+    oracle: dict[int, int] = {}  # bid -> refs
+    for _ in range(2000):
+        op = rng.integers(0, 3)
+        if op == 0:
+            n = int(rng.integers(1, 4))
+            ids = pool.alloc(n)
+            if 24 - 1 - len(oracle) < n:
+                assert ids is None
+            else:
+                assert ids is not None and len(ids) == n
+                for b in ids:
+                    assert b != SCRATCH_BLOCK and b not in oracle
+                    oracle[b] = 1
+        elif op == 1 and oracle:
+            b = int(rng.choice(list(oracle)))
+            pool.incref([b])
+            oracle[b] += 1
+        elif op == 2 and oracle:
+            b = int(rng.choice(list(oracle)))
+            pool.decref([b])
+            oracle[b] -= 1
+            if oracle[b] == 0:
+                del oracle[b]
+        refs = pool.refcounts()
+        assert refs[SCRATCH_BLOCK] == 1
+        for b, r in oracle.items():
+            assert refs[b] == r, (b, refs[b], r)
+        assert pool.free_blocks() == 24 - 1 - len(oracle)
+        for b in range(1, 24):
+            assert pool.shared(b) == (oracle.get(b, 0) > 1)
+    if oracle:
+        pool.decref([b for b, r in oracle.items() for _ in range(r)])
+    assert pool.free_blocks() == 23
+
+
+def test_directory_remap_demote_promote_roundtrip():
+    """Insert-by-reference, lookup leases, demotion to the cold tier under
+    reclaim, and promotion back on a later hit — block DATA round-trips
+    through the host tier exactly (q80 off)."""
+    pool = DeviceKVPool(16, 4)
+    pc = PagedPrefixCache(pool, 4, cold_blocks=8, q80=False)
+    store = {}  # bid -> (k, v) the fake device pool
+
+    def read_block(bid):
+        return store[bid]
+
+    toks = list(range(1, 13))  # 3 full blocks of 4
+    ids = pool.alloc(3)
+    for i, b in enumerate(ids):
+        store[b] = (np.full((2, 2, 4, 8), 10.0 + i, np.float32),
+                    np.full((2, 2, 4, 8), 20.0 + i, np.float32))
+    created = pc.insert_blocks(toks, ids)
+    assert created == 3 and pc.radix.nodes == 3
+    refs = pool.refcounts()
+    assert all(refs[b] == 2 for b in ids)  # slot ref + directory ref
+
+    # zero-copy hit: the lease resolves to the SAME device blocks
+    lease = pc.lookup(toks + [99])
+    assert lease is not None and lease.tokens == 12
+    assert [n.handle for n in lease.nodes] == [("dev", b) for b in ids]
+    pc.mark_seeded(lease, 12)
+    pc.release(lease)
+
+    # the "slot" releases its refs; reclaim demotes all three to the cold
+    # tier and frees the device blocks
+    pool.decref(ids)
+    freed = pc.reclaim(3, read_block)
+    assert freed == 3 and pool.free_blocks() == 15
+    st = pc.stats()
+    assert st["cold_blocks"] == 3 and st["dev_blocks"] == 0
+    assert st["demoted_blocks"] == 3
+
+    # a later hit still matches; promotion restores the exact rows
+    lease = pc.lookup(toks + [99])
+    assert lease is not None and lease.tokens == 12
+    for i, node in enumerate(lease.nodes):
+        tier, h = node.handle
+        assert tier == "cold"
+        k, v = pc.fetch_cold(h)
+        assert np.array_equal(k, store[ids[i]][0])
+        assert np.array_equal(v, store[ids[i]][1])
+        nb = pool.alloc(1)[0]
+        pc.promote(node, nb)
+        assert node.handle == ("dev", nb)
+    assert pc.stats()["dev_blocks"] == 3
+    pc.release(lease)
+    assert pc.total_refs() == 0
+
+
+def test_cold_subtree_eviction_releases_dev_descendants():
+    """Review regression: when a FULL cold tier forces _evict_cold_locked
+    to drop a cold subtree, any dev-tier descendants dropped with it must
+    surrender their pool refs — and the demotion loop must not double-count
+    a victim that rode out with the dropped subtree."""
+    pool = DeviceKVPool(8, 4)
+    pc = PagedPrefixCache(pool, 4, cold_blocks=1, q80=False)
+    store = {}
+
+    def read_block(bid):
+        return store[bid]
+
+    toks = list(range(1, 9))  # 2 full blocks of 4
+    ids = pool.alloc(2)
+    for b in ids:
+        store[b] = (np.full((1, 1, 4, 8), float(b), np.float32),
+                    np.full((1, 1, 4, 8), float(b) + 0.5, np.float32))
+    pc.insert_blocks(toks, ids)
+    pool.decref(ids)  # directory-only refs remain
+    pc.reclaim(1, read_block)   # parent demotes; cold tier now FULL
+    assert pc.stats()["cold_blocks"] == 1
+    pc.reclaim(1, read_block)   # child's demotion must evict the cold
+    # subtree (which contains the child itself) exactly once
+    assert pool.free_blocks() == 7, pool.refcounts()
+    assert pc.radix.nodes == 0
+
+
+def test_reclaim_spares_the_excluded_slot():
+    """Review regression: the adopting slot looks idle (req bound only
+    after _paged_adopt returns) — reclaim must never release the slot the
+    allocation is being performed FOR."""
+    spec = _spec(seq_len=64)
+    params = init_random_params(spec, FloatType.Q40, seed=3)
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                     prefix_cache=False, kv_block_tokens=8)
+    try:
+        slot = be._slots[0]
+        be._paged_ensure(slot, 16)
+        assert len(slot.blocks) == 2 and slot.req is None
+        be._paged_reclaim(10 ** 6, exclude=slot)  # cannot be satisfied
+        assert len(slot.blocks) == 2  # the excluded slot kept its table
+        be._paged_reclaim(10 ** 6)    # unshielded: idle stock IS reclaimed
+        assert slot.blocks == []
+    finally:
+        be.close()
+
+
+# --------------------------------------------------- engine token identity
+
+
+@pytest.fixture(scope="module")
+def engines():
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=23)
+    dense = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                        paged_kv=False, prefix_cache=False)
+    paged = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                        kv_block_tokens=8)
+    yield spec, params, dense, paged
+    paged.close()
+    dense.close()
+
+
+def _run(be, prompt, n, temperature=0.0, seed=0, vocab=256):
+    return be.submit(list(prompt), n,
+                     Sampler(vocab, temperature=temperature,
+                             seed=seed)).wait(timeout=240)
+
+
+SHARED = [1] + [10 + (i * 7) % 90 for i in range(33)]
+
+
+def test_paged_vs_dense_token_identity(engines):
+    """ISSUE 12 acceptance: greedy AND seeded-stochastic outputs are
+    byte-identical paged-vs-dense, including cross-slot directory remaps
+    mid-sequence."""
+    spec, params, dense, paged = engines
+    prompts = [SHARED + [200 + i] for i in range(3)] + [[1, 99, 98]]
+    plans = [(0.0, 0), (0.8, 7), (0.8, 11), (0.0, 0)]
+    wants = [_run(dense, p, 9, t, s) for p, (t, s) in zip(prompts, plans)]
+    # concurrent co-batched mix: pipelined chains, shared radix, remaps
+    # mid-run — every row must still match its dense sequential reference
+    reqs = [paged.submit(list(p), 9, Sampler(spec.vocab_size, temperature=t,
+                                             seed=s))
+            for p, (t, s) in zip(prompts, plans)]
+    outs = [r.wait(timeout=240) for r in reqs]
+    assert outs == wants
+    _settle(lambda: paged.prefix_cache.total_refs() == 0)
+
+
+def test_paged_vs_dense_speculative_identity():
+    """Speculative verify dispatches ride the paged pool byte-identically
+    (repetitive prompts engage real (B, 1+k) verify blocks)."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=5)
+    rep = [9, 21, 33] * 6
+    outs = {}
+    for paged in (False, True):
+        be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                         speculative=4, paged_kv=paged, prefix_cache=paged)
+        try:
+            a = be.submit(list(rep), 16, Sampler(spec.vocab_size))
+            b = be.submit(list(rep[2:]), 16,
+                          Sampler(spec.vocab_size, temperature=0.8, seed=3))
+            outs[paged] = (a.wait(240), b.wait(240))
+            if paged:
+                assert be.verify_steps >= 1  # the verify path really ran
+        finally:
+            be.close()
+    assert outs[True] == outs[False]
+
+
+def test_cache_on_off_identical_and_zero_seed_bytes():
+    """Within the paged engine: directory on vs off is token-identical, the
+    warm resubmit is a REMAP (blocks reused, zero host→device KV bytes),
+    and the prefill skip is real."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=17)
+    prompts = [SHARED + [210 + i] for i in range(3)]
+    outs = {}
+    for on in (False, True):
+        be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                         prefix_cache=on, kv_block_tokens=8)
+        try:
+            outs[on] = [_run(be, prompts[0], 8)]
+            _run(be, [1, 77, 78], 8)  # dirty both slots
+            time.sleep(0.2)
+            base = be.prefilled_tokens
+            outs[on].append(_run(be, prompts[1], 8))
+            if on:
+                # 34 shared tokens -> 4 full 8-token blocks remapped (the
+                # slot's own 1-token rewind overlap counts as resident)
+                assert be.prefilled_tokens - base <= len(prompts[1]) - 32
+                st = be.prefix_cache.stats()
+                assert st["hit_tokens"] + st["resident_tokens"] >= 32
+                assert st["hit_tokens"] >= 31
+                assert be.seed_bytes == 0, be.seed_bytes
+                _settle(lambda: be.prefix_cache.total_refs() == 0)
+            outs[on].append(_run(be, prompts[2], 8))
+        finally:
+            be.close()
+    assert outs[True] == outs[False]
+
+
+def test_resume_over_remapped_blocks_byte_identical():
+    """Durable-resume construction (prompt ⊕ delivered, fast-forwarded
+    sampler) admitted over a DIRECTORY REMAP: the resumed stream must be
+    byte-identical to the uninterrupted run, greedy and stochastic."""
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=29)
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                     kv_block_tokens=8)
+    prompt = SHARED[:17]
+    try:
+        for temperature, seed in ((0.0, 0), (0.8, 13)):
+            smp = Sampler(spec.vocab_size, temperature=temperature, seed=seed)
+            full = be.submit(list(prompt), 16, smp).wait(240)
+            # dirty BOTH slots so the resume MUST come from the directory
+            ra = be.submit([1, 3, 5], 6, Sampler(spec.vocab_size))
+            rb = be.submit([1, 4, 6], 6, Sampler(spec.vocab_size))
+            ra.wait(240), rb.wait(240)
+            time.sleep(0.2)
+            k = 7
+            smp2 = Sampler(spec.vocab_size, temperature=temperature,
+                           seed=seed)
+            smp2.fast_forward(k)
+            req = be.submit(prompt + full[:k], 16 - k, smp2,
+                            resume_tokens=k)
+            rest = req.wait(240)
+            assert full[:k] + rest == full, (temperature, rest)
+            assert req.stats.reused_tokens >= 8  # at least one block remap
+    finally:
+        be.close()
+
+
+def test_cold_promotion_does_not_leak_pool_blocks():
+    """Review regression (confirmed leak): _paged_adopt's cold promotion
+    allocates a device block, promote() takes the directory's ref, and the
+    ALLOCATION ref must be dropped — or every demote→promote cycle orphans
+    one block until the pool starves. Cycle the same prefix through the
+    cold tier and pin used-block conservation."""
+    spec = _spec(seq_len=64)
+    params = init_random_params(spec, FloatType.Q40, seed=7)
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                     kv_block_tokens=8)
+    prompt = SHARED[:17]
+    try:
+        _run(be, prompt, 4)
+        time.sleep(0.2)
+        used = []
+        for i in range(3):
+            be._paged_reclaim(be.kv_pool.n_blocks)  # demote to cold
+            out = _run(be, prompt + [240 + i], 4)   # promote + remap
+            assert len(out) == 4
+            time.sleep(0.2)
+            used.append(be.kv_pool.used_blocks())
+        assert used[2] <= used[0], used  # conservation: no orphaned refs
+        assert be.prefix_cache.stats()["promoted_blocks"] >= 2
+    finally:
+        be.close()
+
+
+def test_context_end_clamp_does_not_corrupt_directory():
+    """Clamped parks (rows near seq_len) overwrite their own tail rows; in
+    paged mode those rows may back DIRECTORY blocks — copy-on-write must
+    keep the shared copies intact, so a later remap still reproduces the
+    dense outputs, and lease pins shrink back to zero."""
+    spec = _spec(seq_len=32)
+    params = init_random_params(spec, FloatType.Q40, seed=5)
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8, 9, 10], [1, 2, 3, 4, 5, 6, 7, 8, 11]]
+    outs = {}
+    for paged in (False, True):
+        be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                         prefix_cache=paged, paged_kv=paged,
+                         kv_block_tokens=4)
+        try:
+            if paged:
+                _run(be, prompts[0], 30)  # warm: harvest + clamp at the wall
+            reqs = [be.submit(list(p), 30, Sampler(spec.vocab_size))
+                    for p in prompts]
+            outs[paged] = [r.wait(240) for r in reqs]
+            for r in reqs:
+                assert r.finish == "length"
+            if paged:
+                # the re-run of prompts[0] after the clamp must have REUSED
+                # directory blocks and still produced the dense tokens
+                assert be.prefix_cache.stats()["hit_tokens"] > 0
+                _settle(lambda: be.prefix_cache.total_refs() == 0)
+        finally:
+            be.close()
+    assert outs[True] == outs[False]
+
+
+def test_pool_exhaustion_fails_only_the_starving_request():
+    """A pool sized for ~one context cannot serve two concurrent long
+    requests: one fails with the typed KVPoolExhausted (request scope), the
+    other completes, the scheduler survives and keeps serving."""
+    spec = _spec(seq_len=64)
+    params = init_random_params(spec, FloatType.Q40, seed=3)
+    w = 64 // 8
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                     kv_block_tokens=8, kv_pool_blocks=w + 2,
+                     prefix_cache=False)
+    try:
+        a = be.submit([1, 2, 3], 56, Sampler(spec.vocab_size))
+        b = be.submit([1, 2, 4], 56, Sampler(spec.vocab_size))
+        res = []
+        for r in (a, b):
+            try:
+                r.wait(timeout=240)
+                res.append(("ok", r))
+            except KVPoolExhausted:
+                res.append(("exhausted", r))
+        kinds = sorted(k for k, _ in res)
+        assert kinds in (["exhausted", "ok"], ["ok", "ok"]), kinds
+        assert be.scheduler_alive()
+        # the engine still serves after the pressure event
+        out = be.submit([1, 9, 9], 6, Sampler(spec.vocab_size)).wait(240)
+        assert len(out) == 6
+    finally:
+        be.close()
+
+
+def test_interpret_kernel_serves_identical_greedy_tokens():
+    """The Pallas paged-attention kernel (interpret mode on CPU) plugged
+    into the full engine serves the same greedy tokens as the XLA gather
+    path — the deterministic end-to-end smoke for the TPU kernel route."""
+    spec = _spec(seq_len=64)  # small W keeps the interpreted grid cheap
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    prompt = SHARED[:12]
+    outs = {}
+    for kernel in (False, True):
+        be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                         kv_block_tokens=8, paged_kernel=kernel)
+        try:
+            assert be._eng.paged_kernel == kernel
+            outs[kernel] = _run(be, prompt, 8)
+        finally:
+            be.close()
+    assert outs[True] == outs[False]
+
+
+def test_paged_attn_bench_parity_gate():
+    """Tier-1 smoke for perf/paged_attn_bench.py: XLA-vs-dense bit
+    exactness, kernel max|Δ| under tolerance, greedy-pick agreement — the
+    decode (T=1) and verify (T=5) shapes."""
+    import paged_attn_bench
+
+    rows = paged_attn_bench.run(small=True)
+    assert {r["shape"] for r in rows} == {"decode_t1", "verify_t5"}
+    for r in rows:
+        assert r["xla_vs_dense_bit_exact"]
+        assert r["kernel_max_abs_err"] < 2e-5
+        assert r["greedy_pick_agree"]

@@ -279,8 +279,11 @@ def test_eviction_under_tiny_pool_keeps_outputs_identical(engines):
 
     spec, be_off, _ = engines
     params = init_random_params(spec, FloatType.Q40, seed=17)
+    # paged_kv=False: this pins the DENSE host pool's eviction semantics
+    # (the --no-paged-kv path); the paged analog lives in test_paged_kv.py
     be = BatchEngine(spec, params, slots=2, tp=1, prefix_cache=True,
-                     prefix_block_tokens=8, prefix_cache_blocks=3)
+                     prefix_block_tokens=8, prefix_cache_blocks=3,
+                     paged_kv=False)
     try:
         prompts = [SHARED + [140 + i] for i in range(2)] + [[1, 77] + [30 + i for i in range(20)]]
         wants = [_run(be_off, p, 6) for p in prompts]
@@ -339,8 +342,11 @@ def test_clamped_park_releases_radix_reservation():
 
     spec = _spec(seq_len=32)
     params = init_random_params(spec, FloatType.Q40, seed=5)
+    # paged_kv=False: white-box test of the DENSE lease-shrink machinery
+    # (slot.history/lease poking); paged leases shrink through the same
+    # _truncate_history path and are covered by test_paged_kv.py
     be = BatchEngine(spec, params, slots=2, tp=1, prefix_cache=True,
-                     prefix_block_tokens=4)
+                     prefix_block_tokens=4, paged_kv=False)
     try:
         prompt = [1] + list(range(2, 26))  # 25 tokens -> 6 full blocks
         _run(be, prompt, 1)

@@ -27,10 +27,11 @@ def test_scan_covers_cache_package():
     files = smoke_lint.repo_py_files()
     rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
     for mod in ("radix", "block_pool", "prefix_cache", "single_slot",
-                "__init__"):
+                "device_pool", "__init__"):
         assert os.path.join("distributed_llama_tpu", "cache",
                             f"{mod}.py") in rel, (mod, sorted(rel)[:5])
     assert os.path.join("perf", "prefix_seed_bench.py") in rel
+    assert os.path.join("perf", "paged_attn_bench.py") in rel
 
 
 def test_scan_covers_fleet_package():
