@@ -585,24 +585,27 @@ def _fleet_get_json(port, path, timeout=10):
 
 
 def _spawn_fleet_replicas(tmp, mpath, tpath, ports, extra_argv=(),
-                          trace_dir=None):
+                          trace_dir=None, per_replica_argv=None):
     """Launch one api_server subprocess per port (tiny fleet checkpoint,
     CPU), env-scrubbed so chaos config never leaks into acceptance
-    replicas. Shared by the shared-prefix and chaos fleet benches — the
-    startup machinery must not drift between them. Returns (procs, logs)."""
+    replicas. Shared by the shared-prefix, chaos, and mixed-context fleet
+    benches — the startup machinery must not drift between them.
+    `per_replica_argv` adds per-index flags (the mixed-context bench's
+    --role split). Returns (procs, logs)."""
     import subprocess
 
     repo_root = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root,
                DLT_HANDOFF_PATH="", DLLAMA_FAULTS="", DLLAMA_FAULT_SEED="")
     procs, logs = [], []
-    for port in ports:
+    for i, port in enumerate(ports):
         log = open(os.path.join(tmp, f"replica_{port}.log"), "w")
         logs.append(log)
+        own = tuple(per_replica_argv[i]) if per_replica_argv else ()
         argv = [sys.executable, "-m", "distributed_llama_tpu.apps.api_server",
                 "--model", mpath, "--tokenizer", tpath, "--chat-template",
                 "chatml", "--host", "127.0.0.1", "--port", str(port),
-                "--batch", "2", "--superstep", "4", *extra_argv]
+                "--batch", "2", "--superstep", "4", *extra_argv, *own]
         if trace_dir is not None:
             # replica-side tracing: the router's GET /v1/trace pulls each
             # replica's live buffer into the merged Perfetto file
@@ -940,6 +943,260 @@ def fleet_shared_prefix_workload(args, spec):
                 proc.kill()
         for log in logs:
             log.close()
+
+
+def mixed_context_workload(args, spec):
+    """--workload mixed-context: the disaggregation acceptance A/B
+    (docs/DISAGG.md). Co-scheduled LONG prefills (unique ~290-char system
+    prompts, 4 decode tokens) and SHORT streaming decode chains (24
+    tokens) drive two 2-replica fleets on an IDENTICAL schedule:
+
+    - **disaggregated** — replica 0 `--role prefill`, replica 1
+      `--role decode`, router `--disagg-threshold` armed: every long
+      prefills on replica 0, ships its KV blocks over /v1/kv, and decodes
+      on replica 1 alongside the shorts (whose dispatches stay narrow);
+    - **monolithic** — both replicas `both`, splitter off: long prefill
+      chunks ride mixed (B, 64) dispatches WITH co-batched short rows,
+      inflating their inter-token gaps (the exact pathology ISSUE 13
+      names).
+
+    Reports short-chain decode TPOT p50/p95 per arm and gates in-run:
+    zero failed requests in both arms, every measured long actually split
+    and imported, the decode replica re-prefilled ZERO shipped tokens
+    (`disagg_reprefill_tokens_total == 0`), and disaggregated TPOT p95
+    strictly below monolithic."""
+    import http.client
+    import subprocess
+    import tempfile
+    import threading
+
+    from distributed_llama_tpu.fleet.router import close_router, serve_router
+
+    tmp = tempfile.mkdtemp(prefix="dlt_disagg_")
+    mpath, tpath = _write_fleet_model(tmp)
+    rounds = max(args.requests, 6)
+    shorts_per_round = 3
+    gen_short, gen_long = 24, 4
+    long_chars, threshold = 288, 48
+
+    rng = np.random.default_rng(0)
+    alpha = list("abcdefgh rstlne")
+    # unique prompts, identical across arms: longs share NO prefix (each
+    # pays a full prefill), shorts stay under the split threshold
+    long_sys = ["".join(rng.choice(alpha) for _ in range(long_chars))
+                for _ in range(rounds + 1)]
+    short_user = ["ask " + "".join(rng.choice(alpha) for _ in range(12))
+                  + f" q{i}" for i in range((rounds + 1) * shorts_per_round)]
+
+    def run_arm(disagg: bool) -> dict:
+        ports = [_fleet_free_port() for _ in range(2)]
+        roles = ((("--role", "prefill"), ("--role", "decode"))
+                 if disagg else None)
+        procs, logs = _spawn_fleet_replicas(tmp, mpath, tpath, ports,
+                                            per_replica_argv=roles)
+        router = None
+        failures: list[str] = []
+        shorts: list[tuple] = []  # (ttft_s, tpot_s)
+        long_e2es: list[float] = []
+        try:
+            _await_fleet_healthy(procs, ports, tmp)
+            router = serve_router(
+                [f"127.0.0.1:{p}" for p in ports], host="127.0.0.1",
+                port=0, poll_interval=0.5, block_bytes=32, retries=2,
+                try_timeout=300.0,
+                disagg_threshold=threshold if disagg else 0)
+            rport = router.server_address[1]
+            threading.Thread(target=router.serve_forever,
+                             daemon=True).start()
+
+            def long_req(i, record):
+                body = {"messages": [
+                    {"role": "system", "content": long_sys[i]},
+                    {"role": "user", "content": "go"}],
+                    "max_tokens": gen_long, "temperature": 0,
+                    "stream": False}
+                t0 = time.perf_counter()
+                conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                                  timeout=600)
+                try:
+                    conn.request("POST", "/v1/chat/completions",
+                                 json.dumps(body),
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    if resp.status != 200:
+                        failures.append(
+                            f"long {i}: status {resp.status} {data[:120]}")
+                    elif record:
+                        long_e2es.append(time.perf_counter() - t0)
+                except Exception as e:
+                    failures.append(f"long {i}: {e!r}")
+                finally:
+                    conn.close()
+
+            def short_req(i, record):
+                body = {"messages": [
+                    {"role": "user", "content": short_user[i]}],
+                    "max_tokens": gen_short, "temperature": 0,
+                    "stream": True}
+                t0 = time.perf_counter()
+                conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                                  timeout=600)
+                try:
+                    conn.request("POST", "/v1/chat/completions",
+                                 json.dumps(body),
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        failures.append(f"short {i}: status {resp.status}")
+                        return
+                    first = last = None
+                    deltas = 0
+                    while True:
+                        line = resp.readline()
+                        if not line:
+                            break
+                        line = line.decode().strip()
+                        if (not line.startswith("data: ")
+                                or line == "data: [DONE]"):
+                            continue
+                        payload = json.loads(line[6:])
+                        if "error" in payload:
+                            failures.append(f"short {i}: {payload['error']}")
+                            return
+                        if payload["choices"][0]["delta"].get("content"):
+                            now = time.perf_counter()
+                            deltas += 1
+                            if first is None:
+                                first = now
+                            last = now
+                    if record and deltas > 1:
+                        shorts.append((first - t0,
+                                       (last - first) / (deltas - 1)))
+                except Exception as e:
+                    failures.append(f"short {i}: {e!r}")
+                finally:
+                    conn.close()
+
+            def run_round(r, record):
+                ths = [threading.Thread(target=long_req, args=(r, record))]
+                ths += [threading.Thread(
+                    target=short_req,
+                    args=(r * shorts_per_round + s, record))
+                    for s in range(shorts_per_round)]
+                ths[0].start()
+                time.sleep(0.05)  # the long admission lands first
+                for t in ths[1:]:
+                    t.start()
+                for t in ths:
+                    t.join(timeout=600)
+
+            run_round(rounds, record=False)  # warm: compiles every shape
+            for r in range(rounds):
+                run_round(r, record=True)
+
+            rep_stats = []
+            for port in ports:
+                try:
+                    rep_stats.append(_fleet_get_json(port, "/v1/stats",
+                                                     timeout=10)[1])
+                except OSError:
+                    rep_stats.append({})
+            return {"failures": failures, "shorts": shorts,
+                    "long_e2es": long_e2es, "rep_stats": rep_stats}
+        finally:
+            if router is not None:
+                close_router(router)
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=90)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            for log in logs:
+                log.close()
+
+    from distributed_llama_tpu.obs import metrics as obs_metrics
+
+    def split_count():
+        fam = (obs_metrics.snapshot()
+               .get("router_disagg_requests_total") or {})
+        return fam.get('{outcome="split"}', 0) or 0
+
+    s0 = split_count()
+    dis = run_arm(disagg=True)
+    dis_splits = split_count() - s0
+    mono = run_arm(disagg=False)
+
+    def pcts(arm):
+        tpots = sorted(t for _ttft, t in arm["shorts"])
+        ttfts = sorted(t for t, _tpot in arm["shorts"])
+        return {
+            "short_requests": len(arm["shorts"]),
+            "decode_tpot_p50_ms": _pct_ms(tpots, 0.50),
+            "decode_tpot_p95_ms": _pct_ms(tpots, 0.95),
+            "ttft_p50_ms": _pct_ms(ttfts, 0.50),
+            "ttft_p95_ms": _pct_ms(ttfts, 0.95),
+            "long_e2e_p50_ms": _pct_ms(sorted(arm["long_e2es"]), 0.50),
+            "failed": len(arm["failures"]),
+            "failures": arm["failures"][:5],
+        }
+
+    def metric_sum(stats_list, name, label=None):
+        total = 0.0
+        for st in stats_list:
+            fam = (st.get("metrics") or {}).get(name)
+            if fam is None:
+                continue
+            if isinstance(fam, dict):
+                total += (fam.get(label, 0) or 0) if label \
+                    else sum(fam.values())
+            else:
+                total += fam
+        return total
+
+    imported = metric_sum(dis["rep_stats"], "disagg_import_requests_total",
+                          '{outcome="imported"}')
+    reprefill = metric_sum(dis["rep_stats"], "disagg_reprefill_tokens_total")
+    da, ma = pcts(dis), pcts(mono)
+    problems = []
+    if dis["failures"] or mono["failures"]:
+        problems.append(f"client-visible failures: disagg "
+                        f"{dis['failures'][:3]}, mono {mono['failures'][:3]}")
+    # every measured long (plus the warm one) must have split and imported
+    if dis_splits < rounds:
+        problems.append(f"only {dis_splits}/{rounds} longs split")
+    if imported < rounds:
+        problems.append(f"only {imported:.0f}/{rounds} imports landed")
+    if reprefill != 0:
+        problems.append(f"streamed admissions re-prefilled {reprefill:.0f} "
+                        "shipped tokens (want 0)")
+    if not (da["decode_tpot_p95_ms"] and ma["decode_tpot_p95_ms"]
+            and da["decode_tpot_p95_ms"] < ma["decode_tpot_p95_ms"]):
+        problems.append(
+            f"disaggregated decode TPOT p95 {da['decode_tpot_p95_ms']} ms "
+            f"not strictly better than monolithic "
+            f"{ma['decode_tpot_p95_ms']} ms")
+    print(json.dumps({
+        "metric": "mixed_context_decode_tpot_p95_ms",
+        "value": da["decode_tpot_p95_ms"], "unit": "ms",
+        "vs_baseline": None,
+        "monolithic_tpot_p95_ms": ma["decode_tpot_p95_ms"],
+        "tpot_p95_speedup": (round(ma["decode_tpot_p95_ms"]
+                                   / da["decode_tpot_p95_ms"], 2)
+                             if da["decode_tpot_p95_ms"]
+                             and ma["decode_tpot_p95_ms"] else None),
+        "disaggregated": da, "monolithic": ma,
+        "rounds": rounds, "shorts_per_round": shorts_per_round,
+        "long_prompt_chars": long_chars, "disagg_threshold": threshold,
+        "longs_split": dis_splits, "imports": imported,
+        "reprefill_tokens": reprefill,
+        "problems": problems,
+    }))
+    if problems:
+        sys.exit(1)
 
 
 def batched_engine_bench(args, spec):
@@ -1856,7 +2113,7 @@ def main():
                          "of decode")
     ap.add_argument("--workload",
                     choices=("shared-prefix", "chaos", "repetition",
-                             "trace"),
+                             "trace", "mixed-context"),
                     default=None,
                     help="scenario mode: 'shared-prefix' drives the BatchEngine "
                          "with a common-system-prompt multi-request workload and "
@@ -1873,7 +2130,12 @@ def main():
                          "--overload x measured capacity with seeded bursty "
                          "arrivals, heavy-tailed lengths, and a weighted "
                          "tenant mix, gating the SLO story in-run "
-                         "(docs/SERVING.md \"Multi-tenant serving\")")
+                         "(docs/SERVING.md \"Multi-tenant serving\"); "
+                         "'mixed-context' A/Bs a role-split disaggregated "
+                         "2-replica fleet against a monolithic one under "
+                         "co-scheduled long prefills + short decode chains, "
+                         "gating decode TPOT p95 and the zero-re-prefill "
+                         "claim in-run (docs/DISAGG.md)")
     ap.add_argument("--overload", type=float, default=2.0, metavar="X",
                     help="trace workload: offered batch load as a multiple "
                          "of the engine's measured sustained capacity")
@@ -2148,6 +2410,11 @@ def main():
             chaos_fleet_workload(args, spec)
         else:
             chaos_workload(args, spec)
+        return
+    if args.workload == "mixed-context":
+        # fixed 2-replica topology per arm (a prefill/decode pair IS the
+        # minimal disaggregated fleet; the monolithic control mirrors it)
+        mixed_context_workload(args, spec)
         return
     if args.workload == "repetition":
         if not on_tpu and not args.small and args.arch == "llama2_7b":

@@ -221,6 +221,31 @@ def run_scenario(keep_engine: bool = False):
         # block-table recompile creep this gate exists to catch.
         rm = eng.submit(list(p2), 6, Sampler(V))
         rm.wait(60)
+        # phase 6 — disaggregation import-seeded admission (docs/DISAGG.md):
+        # a NEVER-SERVED prompt whose KV "arrives over the wire"
+        # (import_kv_blocks → cold directory nodes, round-tripped through
+        # the codec like a real transfer) and is promoted to device at
+        # admission. The import is host bookkeeping and the promotion rides
+        # the untracked single-block pool update; the admission itself must
+        # ride the existing prefill/scan programs — an import-shaped
+        # program key or signature here is disagg-induced recompile creep.
+        if eng.kv_pool is not None:
+            import numpy as _np
+
+            from ..cache.wire import decode_blocks, encode_blocks
+
+            bt = eng._kv_bt
+            p3 = [(13 * i + 2) % V for i in range(bt + 1)]  # 1 full block
+            L, _n, hk, _bt, hs = eng._eng.k_cache.shape
+            rng = _np.random.default_rng(3)
+            blocks = [(rng.standard_normal((L, hk, bt, hs))
+                       .astype(_np.float32),
+                       rng.standard_normal((L, hk, bt, hs))
+                       .astype(_np.float32))]
+            eng.import_kv_blocks(p3[:bt], decode_blocks(
+                encode_blocks(blocks)))
+            ri = eng.submit(list(p3), 4, Sampler(V))
+            ri.wait(60)
         ok = True
     finally:
         # a failed phase must not leak a live engine (scheduler thread +

@@ -77,7 +77,20 @@ _RESUME_REUSED = metrics.counter(
 
 _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
                  "/v1/stats", "/metrics", "/health", "/healthz",
-                 "/v1/requests", "/v1/trace")
+                 "/v1/requests", "/v1/trace", "/v1/kv")
+
+# Prefill-replica side of the disaggregation transfer (docs/DISAGG.md):
+# /v1/kv prefill-only admissions and the chunked block export they feed.
+_KV_PREFILLS = metrics.counter(
+    "disagg_prefill_requests_total",
+    "POST /v1/kv prefill-only admissions by outcome (ok, empty = prompt "
+    "shorter than one full block, error)", labelnames=("outcome",))
+_KV_EXPORT_BLOCKS = metrics.counter(
+    "disagg_export_blocks_total",
+    "KV blocks served to decode replicas over GET /v1/kv/<id>")
+_KV_EXPORT_BYTES = metrics.counter(
+    "disagg_export_bytes_total",
+    "Wire bytes served to decode replicas (post-codec payload)")
 
 def _class_from(body: dict) -> str:
     """Scheduling class from the body's `"class"` field (an X-Class header
@@ -97,6 +110,8 @@ def _count_http(path: str, code: int) -> None:
     path = path.split("?", 1)[0]
     if path.startswith("/v1/requests/"):
         path = "/v1/requests"
+    if path.startswith("/v1/kv/"):
+        path = "/v1/kv"  # per-transfer chunk fetches share one label value
     route = path if path in _KNOWN_ROUTES else "other"
     _HTTP.labels(route=route, code=str(code)).inc()
 
@@ -121,8 +136,23 @@ class ApiState:
                  prefix_cache=True, prefix_cache_blocks: int = 0,
                  prefix_block_tokens: int = 16, prefix_cache_q80: bool = False,
                  request_deadline: float = 0.0,
-                 tenants: TenantRegistry | None = None):
+                 tenants: TenantRegistry | None = None,
+                 role: str = "both", kv_wire_q80: bool = False,
+                 kv_transfer_ttl: float = 120.0, kv_transfer_cap: int = 32):
         self.engine = engine
+        # disaggregation (docs/DISAGG.md): the role this replica ADVERTISES
+        # in its healthz load block (routing preference only — the engine
+        # serves anything), the wire mode for KV exports, and the bounded
+        # TTL'd table of host-snapshot transfers GET /v1/kv/<id> serves
+        from ..fleet.disagg import ROLES, KVTransferTable
+
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        self.role = role
+        self.kv_wire_q80 = kv_wire_q80
+        self.kv_transfers = (KVTransferTable(cap=kv_transfer_cap,
+                                             ttl=kv_transfer_ttl)
+                             if batch_engine is not None else None)
         # multi-tenant policy (docs/SERVING.md "Multi-tenant serving"): the
         # registry the X-Tenant mapping resolves against. With a batch
         # engine the SAME object is the engine's quota/fairness authority
@@ -224,6 +254,9 @@ def _load_block(state: "ApiState") -> dict:
 
     return {"id": state.replica_id, "model": state.model_name,
             "model_hash": model_config_hash(spec),
+            # disaggregation role (docs/DISAGG.md): what role-aware routers
+            # key on; role-less payloads read as "both" on their side
+            "role": state.role,
             "batched": be is not None, "draining": bool(draining),
             # process identity/health for the fleet poller: pid matches the
             # replica's trace export, uptime catches restart loops
@@ -241,6 +274,10 @@ def _stats_payload(state: "ApiState") -> dict:
                  "metrics": metrics.snapshot()}
     if state.supervisor is not None:
         out["supervisor"] = state.supervisor.stats()
+    if state.kv_transfers is not None:
+        out["disagg"] = {"role": state.role,
+                         "kv_wire": "q80" if state.kv_wire_q80 else "raw",
+                         "transfers": state.kv_transfers.stats()}
     if state.tenants is not None:
         out["tenants"] = state.tenants.stats()
     be = state.batch_engine
@@ -399,6 +436,20 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
     if isinstance(mt_raw, bool) or not isinstance(mt_raw, int) or mt_raw < 0:
         raise InvalidRequest(
             f"'max_tokens' must be a non-negative integer, got {mt_raw!r}")
+    # disaggregated admission (docs/DISAGG.md): a router-injected kv_source
+    # descriptor means a prefill replica already computed this prompt's KV —
+    # pull the blocks into the prefix cache BEFORE admission so the radix
+    # lookup remaps/seeds them instead of re-prefilling. Every failure mode
+    # (dead prefill replica, truncated wire, mixed tokenizers) returns 0 and
+    # the request admits with a plain local prefill: zero client impact.
+    imported = 0
+    ks = body.get("kv_source")
+    if isinstance(ks, dict) and state.batch_engine is not None:
+        from ..fleet.disagg import import_kv_source
+
+        imported = import_kv_source(state.batch_engine, prompt, ks)
+        if imported:
+            flight.event(None, "kv_imported", tokens=imported)
     sampler = Sampler(
         spec.vocab_size,
         float(_opt(body, "temperature", state.default_sampler.temperature)),
@@ -523,6 +574,15 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
         gen_tokens = req.stats.generated_tokens if req is not None else 0
         if resume and req is not None:
             _RESUME_REUSED.inc(req.stats.reused_tokens)
+        if imported and req is not None and req.error is None:
+            # shipped-span accounting (docs/DISAGG.md): reuse must cover the
+            # imported span minus the mandatory last-token inference; any
+            # shortfall is a re-prefill of KV that crossed the wire for
+            # nothing (the mixed-context bench asserts the sum stays 0)
+            from ..fleet.disagg import note_reprefill
+
+            note_reprefill(min(imported, len(prompt) - 1),
+                           req.stats.reused_tokens)
         _observe_done(t_start, ttft, gen_tokens, finish[0])
         return "".join(pieces), finish[0]
 
@@ -749,6 +809,8 @@ class Handler(BaseHTTPRequestHandler):
         elif self.path.split("?", 1)[0] == "/v1/requests" \
                 or self.path.startswith("/v1/requests/"):
             self._get_requests()
+        elif self.path.startswith("/v1/kv/"):
+            self._get_kv()
         elif self.path == "/v1/trace":
             # this replica's live Chrome trace (the fleet router's /v1/trace
             # pulls these from every replica and merges them)
@@ -790,7 +852,121 @@ class Handler(BaseHTTPRequestHandler):
         tenant = qs.get("tenant", [None])[0]  # per-tenant filter
         self._json(200, rec.requests(slowest=slowest, tenant=tenant))
 
+    def _post_kv(self):
+        """POST /v1/kv (docs/DISAGG.md): prefill-only admission for the
+        disaggregation transfer. Tokenizes the messages like a completion,
+        runs the prefill through the batch scheduler (one throwaway greedy
+        token — the decode replica generates from token zero with ITS
+        sampler), and registers the host-snapshot blocks in the transfer
+        table. The response is the descriptor the router injects as
+        ``kv_source``; n_blocks 0 tells the planner the prompt was too
+        short to ship (it routes monolithic)."""
+        state = self.state
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body.get("messages"), list) \
+                    or not body["messages"]:
+                raise ValueError("'messages' must be a non-empty array")
+        except (ValueError, json.JSONDecodeError):
+            self._error(400, "Request body is not valid JSON with a "
+                        "non-empty 'messages' array", "invalid_request_error")
+            return
+        be = state.batch_engine
+        if be is None or state.kv_transfers is None:
+            self._error(501, "KV transfer requires a batched engine "
+                        "(--batch > 1)", "invalid_request_error")
+            return
+        try:
+            faults.fire("disagg.prefill")
+            if state.draining:
+                raise EngineDraining("server is draining (shutting down)")
+            tok = be.tokenizer
+            messages = [ChatItem(m.get("role", "user"), m.get("content", ""))
+                        for m in body["messages"] if isinstance(m, dict)]
+            prompt = tok.encode(state.template.generate(messages),
+                                add_bos=True)
+            if len(prompt) >= be.spec.seq_len:
+                raise InvalidRequest(
+                    f"prompt is {len(prompt)} tokens but the model context "
+                    f"is {be.spec.seq_len}")
+            # tenant/class relayed by the planner (docs/DISAGG.md): the
+            # remote prefill is charged to the REQUESTING tenant at its
+            # real class — a batch tenant's split prefills must not jump
+            # the prefill replica's queue as anonymous interactive work
+            tenant = sanitize_tenant(self.headers.get("X-Tenant"))
+            klass = str(self.headers.get("X-Class")
+                        or "interactive").strip().lower()
+            if klass not in CLASSES:
+                klass = "interactive"
+            req = be.submit(prompt, 1,
+                            Sampler(be.spec.vocab_size, 0.0, 0.9, 0),
+                            export_kv=True, tenant=tenant, klass=klass)
+            req.wait(timeout=300)
+        except Exception as e:
+            _KV_PREFILLS.labels(outcome="error").inc()
+            self._mapped_error(e)
+            return
+        exp = req.kv_export
+        if not exp or not exp[1]:
+            _KV_PREFILLS.labels(outcome="empty").inc()
+            self._json(200, {"xfer_id": None, "n_tokens": 0, "n_blocks": 0})
+            return
+        tokens, blocks, bt = exp
+        desc = state.kv_transfers.open(
+            tokens, blocks, bt, "q80" if state.kv_wire_q80 else "raw")
+        _KV_PREFILLS.labels(outcome="ok").inc()
+        self._json(200, desc)
+
+    def _get_kv(self):
+        """GET /v1/kv/<xfer_id>?from=F&n=N (docs/DISAGG.md): serve wire-
+        encoded blocks [F, F+N) of a registered transfer. Every range is an
+        independent request against the host snapshot, so a decode replica
+        resumes a broken transfer by simply re-fetching the range — and an
+        expired/unknown id is an honest 404 its fallback handles."""
+        state = self.state
+        parts = urlsplit(self.path)
+        xfer_id = parts.path[len("/v1/kv/"):]
+        t = (state.kv_transfers.get(xfer_id)
+             if state.kv_transfers is not None else None)
+        if t is None:
+            self._error(404, f"no KV transfer {xfer_id!r} (unknown or "
+                        "expired)", "invalid_request_error")
+            return
+        qs = parse_qs(parts.query)
+        try:
+            frm = int(qs.get("from", ["0"])[0])
+            n = int(qs.get("n", [str(len(t.blocks) - max(frm, 0))])[0])
+        except ValueError:
+            self._error(400, "'from' and 'n' must be integers",
+                        "invalid_request_error")
+            return
+        if frm < 0 or n < 0 or frm + n > len(t.blocks):
+            self._error(400, f"range [{frm}, {frm + n}) outside "
+                        f"[0, {len(t.blocks)})", "invalid_request_error")
+            return
+        try:
+            faults.fire("disagg.export", xfer=xfer_id)
+            from ..cache.wire import encode_blocks
+
+            payload = encode_blocks(t.blocks[frm:frm + n],
+                                    q80=state.kv_wire_q80)
+        except Exception as e:
+            self._error(500, f"export failed: {e}", "server_error")
+            return
+        _KV_EXPORT_BLOCKS.inc(n)
+        _KV_EXPORT_BYTES.inc(len(payload))
+        # a range covering the final block marks the transfer consumed —
+        # its table slot frees after a short retry grace instead of the
+        # full TTL (capped table, docs/DISAGG.md)
+        state.kv_transfers.note_served(t, frm, n)
+        self._raw(200, "application/octet-stream", payload,
+                  {"X-KV-From": str(frm), "X-KV-Count": str(n)})
+
     def do_POST(self):
+        if self.path == "/v1/kv":
+            self._post_kv()
+            return
         if self.path not in ("/v1/chat/completions", "/chat/completions"):
             self._error(404, f"Unknown route: {self.path}", "invalid_request_error")
             return
@@ -948,7 +1124,10 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
           slow_threshold: float = 1.0,
           supervisor_threshold: float = 0.0,
           supervisor_poll: float = 1.0,
-          tenants: TenantRegistry | None = None) -> ThreadingHTTPServer:
+          tenants: TenantRegistry | None = None,
+          role: str = "both", kv_wire_q80: bool = False,
+          kv_transfer_ttl: float = 120.0,
+          kv_transfer_cap: int = 32) -> ThreadingHTTPServer:
     # batched speculative decoding lives in the BatchEngine scheduler
     # (construct it with speculative=K); speculative_k here drives only the
     # sequential engine's per-request verify loop. Guard EVERY caller, not
@@ -973,7 +1152,10 @@ def serve(engine: Engine, host: str = "0.0.0.0", port: int = 9990,
                      prefix_cache_blocks=prefix_cache_blocks,
                      prefix_block_tokens=prefix_block_tokens,
                      prefix_cache_q80=prefix_cache_q80,
-                     request_deadline=request_deadline, tenants=tenants)
+                     request_deadline=request_deadline, tenants=tenants,
+                     role=role, kv_wire_q80=kv_wire_q80,
+                     kv_transfer_ttl=kv_transfer_ttl,
+                     kv_transfer_cap=kv_transfer_cap)
     handler = type("BoundHandler", (Handler,), {"state": state, "protocol_version": "HTTP/1.1"})
     server = QuietServer((host, port), handler)
     server.api_state = state  # drain controller / tests reach the state here
@@ -1159,6 +1341,30 @@ def main(argv=None) -> None:
     p.add_argument("--supervisor-poll", type=float, default=1.0, metavar="S",
                    help="supervisor watchdog sampling period (detection "
                         "latency is threshold + poll)")
+    p.add_argument("--role", choices=("prefill", "decode", "both"),
+                   default="both",
+                   help="disaggregation role advertised in /healthz "
+                        "(docs/DISAGG.md): a role-aware router sends "
+                        "long-prompt admissions to 'prefill' replicas "
+                        "(which ship the resulting KV blocks out over "
+                        "/v1/kv) and decode chains to 'decode' replicas. "
+                        "A routing preference, not a capability — the "
+                        "engine serves anything regardless")
+    p.add_argument("--kv-wire-q80", action="store_true",
+                   help="Q80-compress KV blocks on the /v1/kv export wire "
+                        "(~3.8x fewer bytes than f32; bounded error, not "
+                        "bit-exact — docs/DISAGG.md \"Wire format\")")
+    p.add_argument("--kv-transfer-ttl", type=float, default=120.0,
+                   metavar="S",
+                   help="how long an exported KV transfer stays servable "
+                        "for decode-replica fetches before it expires "
+                        "(fully-fetched transfers free their slot after a "
+                        "short retry grace instead)")
+    p.add_argument("--kv-transfer-cap", type=int, default=32, metavar="N",
+                   help="max concurrently-held KV export transfers (each "
+                        "holds a host snapshot of one prompt's KV blocks); "
+                        "beyond N the oldest is evicted — size it above "
+                        "the expected concurrent long-prompt admissions")
     p.add_argument("--tenants", default=None, metavar="SPEC",
                    help="multi-tenant policy (docs/SERVING.md \"Multi-tenant"
                         " serving\"): ';'-separated "
@@ -1263,7 +1469,10 @@ def main(argv=None) -> None:
                    slow_threshold=args.slow_threshold,
                    supervisor_threshold=args.supervisor_threshold,
                    supervisor_poll=args.supervisor_poll,
-                   tenants=tenants)
+                   tenants=tenants, role=args.role,
+                   kv_wire_q80=args.kv_wire_q80,
+                   kv_transfer_ttl=args.kv_transfer_ttl,
+                   kv_transfer_cap=args.kv_transfer_cap)
     # SIGTERM -> graceful drain (docs/ROBUSTNESS.md): /healthz flips to
     # draining, admissions stop, in-flight requests finish, then shutdown
     install_sigterm_drain(server, server.api_state, args.drain_timeout)

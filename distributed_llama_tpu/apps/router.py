@@ -79,6 +79,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how long a request may wait in the --max-inflight "
                         "fair gate before shedding with 503 + "
                         "drain-derived Retry-After")
+    p.add_argument("--disagg-threshold", type=int, default=0, metavar="T",
+                   help="prefill/decode disaggregation (docs/DISAGG.md): "
+                        "completions whose estimated prompt length (chars/4) "
+                        "is at least T tokens run their prefill on a "
+                        "prefill-capable replica (--role prefill on the "
+                        "api_server) and ship the KV blocks to a decode "
+                        "replica over /v1/kv; routing becomes role-aware. "
+                        "0 = off (monolithic fleet, the default)")
+    p.add_argument("--disagg-timeout", type=float, default=60.0, metavar="S",
+                   help="timeout of the planner's /v1/kv prefill POST; on "
+                        "expiry the request routes monolithic")
     p.add_argument("--seed", type=int, default=0,
                    help="random-routing RNG seed (A/B reproducibility)")
     p.add_argument("--trace", default=None, metavar="OUT.json",
@@ -104,7 +115,9 @@ def main(argv=None) -> None:
         block_bytes=args.block_bytes, affinity_nodes=args.affinity_nodes,
         retries=args.retries, try_timeout=args.proxy_timeout, seed=args.seed,
         durable=not args.no_durable, tenants=args.tenants,
-        max_inflight=args.max_inflight, gate_timeout=args.gate_timeout)
+        max_inflight=args.max_inflight, gate_timeout=args.gate_timeout,
+        disagg_threshold=args.disagg_threshold,
+        disagg_timeout=args.disagg_timeout)
 
     def _on_term(signum, frame):
         # the router holds no request state worth draining beyond in-flight

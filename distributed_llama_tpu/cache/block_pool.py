@@ -35,7 +35,7 @@ import itertools
 
 import numpy as np
 
-from ..quants import QK, dequantize_q80, quantize_q80
+from .wire import q80_compress, q80_compressible, q80_restore
 
 __all__ = ["HostKVArena", "KVBlockPool"]
 
@@ -176,8 +176,8 @@ class KVBlockPool:
         k, v = b.k, b.v
         if k is not None and v is not None:  # demotion may land between reads
             return k, v
-        k = dequantize_q80(*b.kq).reshape(b.shape).astype(b.dtype)
-        v = dequantize_q80(*b.vq).reshape(b.shape).astype(b.dtype)
+        k = q80_restore(b.kq, b.shape, b.dtype)
+        v = q80_restore(b.vq, b.shape, b.dtype)
         return k, v
 
     def is_cold(self, handle: int) -> bool:
@@ -200,12 +200,11 @@ class KVBlockPool:
         # nsmallest over the (normally 1-deep) excess: O(H), not a full sort
         # per put — a harvest inserts block-by-block and each put can push the
         # tier over budget by at most one
-        compressible = (b for b in hot if int(np.prod(b.shape)) % QK == 0)
+        compressible = (b for b in hot if q80_compressible(b.shape))
         for b in heapq.nsmallest(excess, compressible, key=lambda b: b.seq):
-            n = int(np.prod(b.shape))
-            # f32 intermediary: quantize_q80 upcasts anyway, and bf16 ndarrays
-            # (ml_dtypes) don't support every ufunc the quantizer uses
-            b.kq = quantize_q80(np.asarray(b.k, np.float32).reshape(n))
-            b.vq = quantize_q80(np.asarray(b.v, np.float32).reshape(n))
+            # cache/wire.py owns the round trip (shared with the disagg
+            # wire codec so the tiers can never drift apart)
+            b.kq = q80_compress(b.k)
+            b.vq = q80_compress(b.v)
             b.k = b.v = None
             self.demoted_blocks += 1

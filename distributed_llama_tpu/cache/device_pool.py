@@ -315,6 +315,52 @@ class PagedPrefixCache:
         _INSERTED.inc(created)
         return created
 
+    def insert_cold(self, tokens: list[int], blocks: list) -> int:
+        """Import externally-supplied HOST rows (disaggregation transfer,
+        docs/DISAGG.md) as COLD directory nodes: `blocks[i]` is the (k, v)
+        host pair for token block i of `tokens`. No device work — the
+        existing admission path promotes cold nodes on the first hit, on
+        the scheduler thread, so this is safe from any thread. Positions
+        the tree already covers keep their existing (possibly device-tier)
+        blocks; the supplied copy is simply unused there. A full cold tier
+        first evicts its LRU unreferenced subtrees; if it still refuses,
+        the chain stops at the last block that fit (prefix-closed by
+        construction). Returns how many blocks of `tokens` the directory
+        COVERS after the insert (pre-existing nodes count — the importer
+        cares about servable span, not authorship)."""
+        from .prefix_cache import _INSERTED
+
+        if self.cold is None:
+            return 0
+        bt = self.block_tokens
+        n_blocks = min(len(tokens) // bt, len(blocks))
+        if n_blocks == 0:
+            return 0
+        blocked = tokens[:n_blocks * bt]
+        created = 0
+        dev_freed: list[int] = []
+
+        def make_handle(i: int):
+            nonlocal created
+            k, v = blocks[i]
+            h = self.cold.put(k, v)
+            if h is None:
+                dev_freed.extend(self._evict_cold_locked(1))
+                h = self.cold.put(k, v)
+            if h is None:
+                return None  # cold tier pinned full: stop extending
+            created += 1
+            return ("cold", h)
+
+        with self._lock:
+            chain = self.radix.insert(blocked, make_handle)
+        if dev_freed:
+            # dev-tier descendants dropped with an evicted cold subtree
+            # surrender their pool refs (same contract as reclaim())
+            self.pool.decref(dev_freed)
+        _INSERTED.inc(created)
+        return len(chain)
+
     def promote(self, node: RadixNode, new_bid: int) -> None:
         """A cold node's rows were uploaded into freshly-allocated device
         block `new_bid` (the engine did the transfer): the directory adopts

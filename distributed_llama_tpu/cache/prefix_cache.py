@@ -291,6 +291,14 @@ class PrefixCache:
         self._publish_gauges()
         return created
 
+    def covered_blocks(self, tokens: list[int]) -> int:
+        """How many leading FULL blocks of `tokens` the index currently
+        holds — import accounting (docs/DISAGG.md): the caller reports the
+        span the cache can actually serve, not the span it was handed. No
+        refs acquired; touches LRU stamps like any match."""
+        with self._lock:
+            return len(self.radix.match(tokens))
+
     def total_refs(self) -> int:
         """Live reservation count, read under the lock (a scheduler-thread
         insert may be mutating the tree concurrently)."""

@@ -5,11 +5,14 @@ backoff for unreachable ones), `affinity` maps request prefixes to the
 replica whose KV cache already holds them (the cache/radix.py trie re-used
 router-side), `journal` records every in-flight durable request so a
 mid-stream replica failure is resumed instead of surfaced (docs/FLEET.md
-"Resume protocol"), `router` fronts the fleet with durable failover
+"Resume protocol"), `disagg` splits long-prompt completions across
+prefill/decode roles with KV-block streaming between replicas
+(docs/DISAGG.md), `router` fronts the fleet with durable failover
 proxying and replica-labeled aggregated metrics. docs/FLEET.md.
 """
 
 from .affinity import AffinityMap  # noqa: F401
+from .disagg import DisaggPlanner, KVTransferTable  # noqa: F401
 from .journal import JournalEntry, RequestJournal  # noqa: F401
 from .membership import Membership, Replica  # noqa: F401
 from .router import (close_router, fleet_metrics, fleet_stats,  # noqa: F401

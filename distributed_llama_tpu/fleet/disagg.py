@@ -1,0 +1,460 @@
+"""Prefill/decode disaggregation: role-aware planning + KV-block streaming.
+
+The topology (docs/DISAGG.md): replicas advertise a ROLE in their healthz
+load block — ``prefill`` (long-prompt admissions land here), ``decode``
+(short chains and the decode half of split requests), or ``both`` (the
+monolithic default). The router splits a long-prompt completion in two:
+
+1. **plan** (`DisaggPlanner.plan`, router side, stdlib-only): estimate the
+   prompt length (chars/4 — the router never tokenizes, same arithmetic as
+   the tenancy cost model); at/over the threshold, POST the request's
+   messages to a prefill-capable replica's ``/v1/kv``. That replica runs
+   the prefill into its own device pool, snapshots the committed
+   prompt-prefix KV blocks to host, and answers with a transfer
+   descriptor (xfer id, token count, block geometry, token hash, wire
+   mode). The descriptor is injected into the request body as
+   ``kv_source`` and the request routes onward preferring decode-capable
+   replicas.
+
+2. **import** (`import_kv_source`, decode-replica side): before admission,
+   the decode replica verifies the descriptor against ITS OWN tokenization
+   (token-hash mismatch = different tokenizer/model — skip, local
+   prefill), then pulls the blocks over HTTP in bounded chunks
+   (``GET /v1/kv/<id>?from=F&n=N`` — every range is independently
+   re-fetchable, so a flaky connection retries per chunk) and inserts them
+   into the engine's prefix cache as HOST blocks (`BatchEngine.
+   import_kv_blocks`): a paged directory adopts them as COLD nodes and the
+   existing admission path promotes them to device; a dense cache inserts
+   them into its host pool and the existing seed path scatters them. The
+   import is therefore pure host bookkeeping — no device array is ever
+   touched off the scheduler thread — and admission then reuses the
+   shipped span instead of re-prefilling ("resume at token 0 with shipped
+   KV", the degenerate case of PR 9's resume protocol).
+
+**Failure semantics**: every failure in the split path degrades to the
+monolithic behavior with zero client-visible effect — a failed plan or
+prefill POST routes the untouched request normally; a mid-transfer death
+(prefill replica killed, truncated wire buffer, chunk fetch exhausting its
+retry) abandons the import and the decode replica simply prefills locally.
+The fault matrix pins this (perf/fault_matrix.py disagg family).
+
+This module is imported by the stdlib-only router process: numpy and the
+wire codec (cache/wire.py) load lazily inside the decode-replica-side
+functions only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import uuid
+from http.client import HTTPConnection
+
+from ..obs import metrics, trace
+from ..resilience import faults
+from .membership import Membership, Replica, parse_addr
+
+__all__ = ["ROLES", "PREFILL_ROLES", "DECODE_ROLES", "DisaggPlanner",
+           "KVTransferTable", "tokens_hash", "estimate_prompt_tokens",
+           "fetch_kv_blocks", "import_kv_source"]
+
+ROLES = ("prefill", "decode", "both")
+PREFILL_ROLES = ("prefill", "both")
+DECODE_ROLES = ("decode", "both")
+
+# Router-side disaggregation telemetry (docs/OBSERVABILITY.md).
+_PLANNED = metrics.counter(
+    "router_disagg_requests_total",
+    "Long-prompt completions considered for prefill/decode splitting, by "
+    "outcome: split (KV shipped), warm (a decode-capable replica already "
+    "holds the prefix — routed there, no transfer), no_topology (no "
+    "distinct prefill+decode pair in rotation), empty (prompt too short "
+    "for one full block), or prefill_error (the prefill POST failed — "
+    "routed monolithic)", labelnames=("outcome",))
+_PREFILL_SECONDS = metrics.histogram(
+    "router_disagg_prefill_seconds",
+    "Wall time of the planner's /v1/kv prefill POST (remote prefill + "
+    "host KV snapshot, before the decode leg is routed)")
+
+# Decode-replica-side import telemetry.
+_IMPORTS = metrics.counter(
+    "disagg_import_requests_total",
+    "kv_source imports attempted at the decode replica, by outcome: "
+    "imported, config_mismatch (block geometry differs), hash_mismatch "
+    "(tokenizations disagree), error (fetch/decode failed -> local "
+    "prefill), empty (descriptor carried no blocks)",
+    labelnames=("outcome",))
+_IMPORT_TOKENS = metrics.counter(
+    "disagg_import_tokens_total",
+    "Prompt tokens whose KV arrived over the wire and entered the prefix "
+    "cache (the span admission reuses instead of re-prefilling)")
+_IMPORT_BYTES = metrics.counter(
+    "disagg_import_bytes_total",
+    "Wire bytes fetched from prefill replicas (post-codec payload)")
+_IMPORT_SECONDS = metrics.histogram(
+    "disagg_import_seconds",
+    "Wall time of one kv_source import (all chunk fetches + host insert)")
+_REPREFILL = metrics.counter(
+    "disagg_reprefill_tokens_total",
+    "Shipped-span tokens a disaggregated admission re-prefilled anyway "
+    "(0 in a healthy fleet — the mixed-context bench asserts it in-run; "
+    "nonzero means the imported blocks missed the radix lookup)")
+
+
+def tokens_hash(tokens) -> str:
+    """Short stable hash of a token-id sequence. The decode replica compares
+    it against its OWN tokenization of the prompt before importing: a
+    mismatch means the fleet is serving mixed tokenizers/models (rolling
+    upgrade) and the shipped KV would seed garbage."""
+    h = hashlib.sha1()
+    for t in tokens:
+        h.update(int(t).to_bytes(4, "little"))
+    return h.hexdigest()[:16]
+
+
+def estimate_prompt_tokens(body: dict) -> float:
+    """Router-side prompt-length estimate: rendered chars / 4 plus a few
+    per-message template tokens (the router never tokenizes — same
+    arithmetic as the tenancy cost model)."""
+    chars = 0
+    msgs = 0
+    for m in body.get("messages", []):
+        if isinstance(m, dict):
+            chars += len(str(m.get("content", "")))
+            msgs += 1
+    return chars / 4.0 + 4.0 * msgs
+
+
+# ----------------------------------------------------------------------
+# router side: the planner
+# ----------------------------------------------------------------------
+
+class DisaggPlanner:
+    """Decides which completions split and executes the prefill leg.
+    Stateless beyond config — every decision reads the live membership."""
+
+    def __init__(self, threshold_tokens: int = 0, timeout: float = 60.0):
+        self.threshold = max(int(threshold_tokens), 0)
+        self.timeout = timeout
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    # -- role-aware routing preference ---------------------------------
+
+    def warm_decode(self, membership: Membership, affinity,
+                    key: bytes) -> str | None:
+        """Replica id of a decode-capable replica whose recorded routes
+        cover EVERY full block of the request's affinity key — the prefix
+        is already hot there, so shipping KV it already holds would waste
+        a whole transfer (`insert` would discard the copies). Same
+        staleness caveat as affinity routing itself: a restarted replica's
+        stale record costs one cold prefill, never correctness."""
+        if affinity is None or not key:
+            return None
+        decode_ids = {r.id for r in membership.in_rotation()
+                      if r.role in DECODE_ROLES}
+        if not decode_ids:
+            return None
+        rep, depth = affinity.lookup(key, decode_ids)
+        if rep is not None and depth >= max(
+                len(key) // affinity.block_bytes, 1):
+            return rep
+        return None
+
+    def prefer_roles(self, body: dict, membership: Membership,
+                     affinity=None, key: bytes = b"") -> tuple | None:
+        """Role filter for pick(): requests carrying shipped KV (or short
+        decode chains) prefer decode-capable replicas; long prompts that
+        did NOT split prefer prefill-capable ones — UNLESS a decode
+        replica already holds the prefix (warm_decode), in which case the
+        request should follow the warm cache. None when the fleet is
+        homogeneous (all "both") — role preference must not perturb
+        monolithic fleets."""
+        if not self.enabled:
+            return None
+        if not any(r.role != "both" for r in membership.replicas):
+            return None
+        if "kv_source" in body:
+            return DECODE_ROLES
+        if estimate_prompt_tokens(body) >= self.threshold:
+            if self.warm_decode(membership, affinity, key) is not None:
+                return DECODE_ROLES
+            return PREFILL_ROLES
+        return DECODE_ROLES
+
+    # -- the split ------------------------------------------------------
+
+    def plan(self, membership: Membership, body: dict,
+             tenant_hdrs: dict | None = None, affinity=None,
+             key: bytes = b"") -> dict | None:
+        """Attempt the split: returns the ``kv_source`` descriptor to inject
+        into the request body, or None for the monolithic path. Never
+        raises — every failure degrades to monolithic routing.
+        `tenant_hdrs` (X-Tenant/X-Class) are relayed onto the prefill leg
+        so the prefill replica's quota/fairness accounting attributes the
+        remote prefill to the requesting tenant at its real class."""
+        # a body already carrying kv_source or resume went through a first
+        # pass (client-side durability layer, or a journaled failover whose
+        # entry kept the injected descriptor) — never re-split it
+        if (not self.enabled or "kv_source" in body or "resume" in body
+                or estimate_prompt_tokens(body) < self.threshold):
+            return None
+        rotation = membership.in_rotation()
+        if not any(r.role != "both" for r in rotation):
+            # homogeneous fleet (all "both" — including role-less replicas
+            # mid-rolling-upgrade, which parse as "both"): never split.
+            # Splitting here would pay a remote prefill for zero isolation
+            # gain, and pre-role replicas don't even serve /v1/kv — the
+            # same heterogeneity gate prefer_roles() applies.
+            _PLANNED.labels(outcome="no_topology").inc()
+            return None
+        if self.warm_decode(membership, affinity, key) is not None:
+            # the prefix is already hot on a decode-capable replica:
+            # routing there (prefer_roles follows the same signal) beats
+            # shipping KV its cache would discard as already-covered
+            _PLANNED.labels(outcome="warm").inc()
+            return None
+        dedicated = [r for r in rotation if r.role == "prefill"]
+        prefills = dedicated or [r for r in rotation if r.role == "both"]
+        decodes = [r for r in rotation if r.role in DECODE_ROLES]
+        if not prefills:
+            _PLANNED.labels(outcome="no_topology").inc()
+            return None
+        pre = min(prefills, key=Replica.load_score)
+        if not any(d.id != pre.id for d in decodes):
+            # no DISTINCT decode candidate: shipping KV back to the same
+            # replica is strictly worse than serving it monolithic
+            _PLANNED.labels(outcome="no_topology").inc()
+            return None
+        t0 = time.perf_counter()
+        try:
+            faults.fire("disagg.plan", replica=pre.id)
+            with trace.span("disagg.plan", {"replica": pre.id}):
+                desc = self._start_transfer(pre, body, tenant_hdrs)
+        except Exception:
+            _PLANNED.labels(outcome="prefill_error").inc()
+            return None
+        _PREFILL_SECONDS.observe(time.perf_counter() - t0)
+        if not desc or not desc.get("n_blocks"):
+            _PLANNED.labels(outcome="empty").inc()
+            return None
+        desc["replica"] = pre.id
+        _PLANNED.labels(outcome="split").inc()
+        return desc
+
+    def _start_transfer(self, rep: Replica, body: dict,
+                        tenant_hdrs: dict | None = None) -> dict | None:
+        """POST /v1/kv on the prefill replica: run the prefill, snapshot
+        the blocks, get the transfer descriptor back."""
+        payload = {"messages": body.get("messages", [])}
+        headers = {"Content-Type": "application/json"}
+        if tenant_hdrs:
+            headers.update(tenant_hdrs)
+        conn = HTTPConnection(rep.host, rep.port, timeout=self.timeout)
+        try:
+            conn.request("POST", "/v1/kv", json.dumps(payload).encode(),
+                         headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                # a refusing prefill replica (4xx/5xx) is a failed PLAN,
+                # not an empty transfer — count it as prefill_error
+                raise RuntimeError(f"/v1/kv -> {resp.status}")
+            desc = json.loads(data)
+            if not isinstance(desc, dict):
+                raise RuntimeError("/v1/kv returned a non-object body")
+            return desc
+        finally:
+            conn.close()
+
+
+# ----------------------------------------------------------------------
+# prefill-replica side: the transfer table
+# ----------------------------------------------------------------------
+
+class _Transfer:
+    __slots__ = ("xfer_id", "tokens", "blocks", "block_tokens", "created")
+
+    def __init__(self, xfer_id, tokens, blocks, block_tokens):
+        self.xfer_id = xfer_id
+        self.tokens = tokens          # token ids the blocks cover
+        self.blocks = blocks          # [(k, v)] host arrays per block
+        self.block_tokens = block_tokens
+        self.created = time.monotonic()
+
+
+class KVTransferTable:
+    """Bounded TTL'd table of exportable prefill transfers on a replica.
+    Entries hold HOST snapshots of the committed prompt blocks (taken on
+    the scheduler thread at request finish), so an export range is
+    re-servable for the whole TTL whatever the device pool does meanwhile
+    — that is what makes the chunked transfer resumable. Once a fetch
+    covers the FINAL block the transfer is CONSUMED: its remaining
+    lifetime drops to `consumed_ttl` (late retries can still re-fetch
+    briefly) so completed transfers stop crowding the capped table out
+    from under still-pending ones."""
+
+    def __init__(self, cap: int = 32, ttl: float = 120.0,
+                 consumed_ttl: float = 10.0):
+        self.cap = max(int(cap), 1)
+        self.ttl = ttl
+        self.consumed_ttl = max(consumed_ttl, 0.0)
+        self._lock = threading.Lock()  # guards: _live
+        self._live: dict[str, _Transfer] = {}
+
+    def _sweep_locked(self) -> None:  # holds: self._lock
+        """TTL expiry only — cap-eviction lives in open() (get()/stats()
+        sweep too, and must never evict a LIVE entry to 'make room')."""
+        now = time.monotonic()
+        dead = [x for x, t in self._live.items()
+                if now - t.created > self.ttl]
+        for x in dead:
+            del self._live[x]
+
+    def open(self, tokens: list[int], blocks: list,
+             block_tokens: int, wire: str) -> dict:
+        """Register a transfer; returns the descriptor the planner injects
+        as ``kv_source`` (sans the replica address, which the ROUTER fills
+        in — the replica may be bound to 0.0.0.0)."""
+        xfer_id = f"kv-{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._sweep_locked()
+            while len(self._live) >= self.cap:  # room for the NEW entry
+                oldest = min(self._live.values(), key=lambda t: t.created)
+                del self._live[oldest.xfer_id]
+            self._live[xfer_id] = _Transfer(xfer_id, list(tokens),
+                                            list(blocks), block_tokens)
+        return {"xfer_id": xfer_id, "n_tokens": len(tokens),
+                "n_blocks": len(blocks), "block_tokens": block_tokens,
+                "tokens_hash": tokens_hash(tokens), "wire": wire}
+
+    def get(self, xfer_id: str) -> _Transfer | None:
+        with self._lock:
+            # full sweep, not just this id: expiry must not be open()-lazy,
+            # or an idle prefill replica pins up to `cap` host KV snapshots
+            # long past their TTL (and stats() would overstate pressure)
+            self._sweep_locked()
+            return self._live.get(xfer_id)
+
+    def note_served(self, t: _Transfer, frm: int, n: int) -> None:
+        """Consumption tracking: a range covering the final block marks the
+        transfer consumed — rebase its clock so only `consumed_ttl` of
+        lifetime remains (never EXTENDS a transfer's life)."""
+        if frm + n < len(t.blocks):
+            return
+        with self._lock:
+            t.created = min(
+                t.created,
+                time.monotonic() - max(self.ttl - self.consumed_ttl, 0.0))
+
+    def stats(self) -> dict:
+        with self._lock:
+            self._sweep_locked()
+            return {"live": len(self._live), "cap": self.cap,
+                    "ttl_s": self.ttl}
+
+
+# ----------------------------------------------------------------------
+# decode-replica side: fetch + import
+# ----------------------------------------------------------------------
+
+def fetch_kv_blocks(host: str, port: int, xfer_id: str, frm: int, n: int,
+                    timeout: float = 30.0) -> list:
+    """Fetch blocks [frm, frm+n) of a transfer and decode them to host
+    (K, V) pairs. One HTTP request per call — any range is independently
+    re-fetchable (the resumability primitive). Lazy-imports the wire codec
+    (numpy): the stdlib-only router imports this module but never calls
+    this."""
+    from ..cache.wire import decode_blocks
+
+    faults.fire("disagg.fetch", xfer=xfer_id)
+    conn = HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", f"/v1/kv/{xfer_id}?from={frm}&n={n}")
+        resp = conn.getresponse()
+        data = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"kv fetch {xfer_id}[{frm}:{frm + n}] -> {resp.status}")
+    finally:
+        conn.close()
+    _IMPORT_BYTES.inc(len(data))
+    blocks = decode_blocks(data)
+    if len(blocks) != n:
+        raise RuntimeError(
+            f"kv fetch {xfer_id}[{frm}:{frm + n}] returned {len(blocks)} "
+            "blocks")
+    return blocks
+
+
+def import_kv_source(engine, prompt: list[int], ks: dict, *,
+                     timeout: float = 30.0, chunk_blocks: int = 4) -> int:
+    """Pull a ``kv_source`` transfer into `engine`'s prefix cache; returns
+    the token span now servable from cache (0 on ANY failure — the caller
+    simply admits with a local prefill, docs/DISAGG.md "Failure
+    semantics"). Each chunk gets one retry before the import is abandoned:
+    a transient connection blip resumes mid-transfer, a dead prefill
+    replica fails both attempts and degrades."""
+    t0 = time.perf_counter()
+    try:
+        n_tokens = int(ks["n_tokens"])
+        n_blocks = int(ks["n_blocks"])
+        bt = int(ks["block_tokens"])
+        host, port = parse_addr(str(ks["replica"]))
+        xfer_id = str(ks["xfer_id"])
+    except (KeyError, TypeError, ValueError):
+        _IMPORTS.labels(outcome="error").inc()
+        return 0
+    if n_blocks <= 0:
+        _IMPORTS.labels(outcome="empty").inc()
+        return 0
+    pc = getattr(engine, "prefix_cache", None)
+    if pc is None or bt != pc.block_tokens or n_tokens > len(prompt) \
+            or n_tokens != n_blocks * bt:
+        _IMPORTS.labels(outcome="config_mismatch").inc()
+        return 0
+    if tokens_hash(prompt[:n_tokens]) != ks.get("tokens_hash"):
+        # different tokenization (mixed fleet / rolling upgrade): the
+        # shipped rows would seed KV for tokens this replica never saw
+        _IMPORTS.labels(outcome="hash_mismatch").inc()
+        return 0
+    blocks: list = []
+    try:
+        with trace.span("disagg.import",
+                        {"xfer": xfer_id, "blocks": n_blocks}):
+            for frm in range(0, n_blocks, max(chunk_blocks, 1)):
+                want = min(max(chunk_blocks, 1), n_blocks - frm)
+                for attempt in (0, 1):  # per-chunk retry: resumable ranges
+                    try:
+                        blocks.extend(
+                            fetch_kv_blocks(host, port, xfer_id, frm,
+                                            want, timeout=timeout))
+                        break
+                    except Exception:
+                        if attempt:
+                            raise
+            imported = engine.import_kv_blocks(prompt[:n_tokens], blocks)
+    except Exception:
+        _IMPORTS.labels(outcome="error").inc()
+        return 0
+    if imported <= 0:
+        _IMPORTS.labels(outcome="empty").inc()
+        return 0
+    _IMPORTS.labels(outcome="imported").inc()
+    _IMPORT_TOKENS.inc(imported)
+    _IMPORT_SECONDS.observe(time.perf_counter() - t0)
+    return imported
+
+
+def note_reprefill(shipped: int, reused: int) -> int:
+    """Admission accounting for a streamed-KV request: how many shipped
+    tokens were re-prefilled anyway (reuse fell short of the shipped
+    span). The mixed-context bench asserts the fleet-wide sum stays 0."""
+    missed = max(shipped - reused, 0)
+    if missed:
+        _REPREFILL.inc(missed)
+    return missed

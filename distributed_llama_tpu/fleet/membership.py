@@ -70,6 +70,15 @@ class Replica:
     healthy: bool = False
     draining: bool = False
     status: str = "unpolled"   # ok | draining | unhealthy | unreachable | unpolled
+    # disaggregation role (docs/DISAGG.md): what the replica ADVERTISES in
+    # its healthz load block — "prefill" (long-prompt admissions land here,
+    # KV shipped out), "decode" (imports KV, runs decode chains), or "both"
+    # (the monolithic default). Roles are routing preferences, not hard
+    # capabilities: every replica runs the full engine, so a degraded fleet
+    # can still serve anything anywhere. Replicas predating the role field
+    # (an old healthz payload) read as "both" — back-compat pinned by
+    # tests/test_disagg.py.
+    role: str = "both"
     model_hash: str | None = None
     slots: int = 0
     free_slots: int = 0
@@ -92,7 +101,7 @@ class Replica:
     next_poll_t: float = 0.0       # monotonic; 0 = poll normally
     down_since: float = 0.0        # monotonic of the first failed poll
     last_down_log: float = 0.0     # rate-limits the "still down" line
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)  # guards: healthy, draining, status, consecutive_failures, slots, free_slots, queue_depth, model_hash, pid, uptime_s, inflight, last_ok
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)  # guards: healthy, draining, status, consecutive_failures, slots, free_slots, queue_depth, model_hash, pid, uptime_s, inflight, last_ok, role
 
     def __post_init__(self):
         if not self.id:
@@ -109,6 +118,7 @@ class Replica:
         with self._lock:
             return {"id": self.id, "healthy": self.healthy,
                     "draining": self.draining, "status": self.status,
+                    "role": self.role,
                     "model_hash": self.model_hash, "slots": self.slots,
                     "free_slots": self.free_slots,
                     "queue_depth": self.queue_depth,
@@ -141,6 +151,9 @@ class Replica:
             self.queue_depth = int(block.get("queue_depth",
                                              self.queue_depth) or 0)
             self.model_hash = block.get("model_hash", self.model_hash)
+            # role-less payloads (pre-disagg replicas, rolling upgrades)
+            # read as "both" — the monolithic behavior they implement
+            self.role = str(block.get("role") or "both")
             prev_uptime = self.uptime_s
             self.pid = int(block.get("pid", self.pid) or 0)
             self.uptime_s = float(block.get("uptime_s",

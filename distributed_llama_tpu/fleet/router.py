@@ -47,6 +47,7 @@ from ..resilience.quiet_http import QuietServer
 from ..resilience.tenancy import (DrainRate, FairGate, TenantRegistry,
                                   sanitize_tenant)
 from .affinity import AffinityMap
+from .disagg import DisaggPlanner
 from .journal import RequestJournal, iter_sse_data, parse_chunk
 from .membership import Membership, Replica
 
@@ -106,9 +107,16 @@ class RouterState:
                  seed: int = 0, durable: bool = True,
                  journal_inflight: int = 4096,
                  tenants: TenantRegistry | None = None,
-                 max_inflight: int = 0, gate_timeout: float = 30.0):
+                 max_inflight: int = 0, gate_timeout: float = 30.0,
+                 disagg_threshold: int = 0, disagg_timeout: float = 60.0):
         assert policy in ("affinity", "random"), policy
         self.membership = membership
+        # prefill/decode disaggregation (docs/DISAGG.md): when the threshold
+        # is armed, long-prompt completions run their prefill on a
+        # prefill-capable replica, whose KV blocks the decode replica then
+        # imports — and routing becomes role-aware (short chains prefer
+        # decode replicas, unsplit long prompts prefer prefill ones)
+        self.disagg = DisaggPlanner(disagg_threshold, timeout=disagg_timeout)
         # Multi-tenant fleet edge (docs/SERVING.md "Multi-tenant serving"):
         # optional router-level token-bucket quotas (429 before any proxy
         # work) and a weighted-fair inflight gate replacing the implicit
@@ -158,14 +166,24 @@ class RouterState:
                 break
         return b"".join(parts)[:self.key_bytes]
 
-    def pick(self, key: bytes, tried: set[str]) -> tuple[Replica | None, str]:
+    def pick(self, key: bytes, tried: set[str],
+             prefer_roles: tuple | None = None
+             ) -> tuple[Replica | None, str]:
         """(replica, reason) for the next try; (None, "saturated") when no
         routable replica remains. Reasons: affinity | least_loaded | random
-        on the first try, failover afterwards."""
+        on the first try, failover afterwards. `prefer_roles` (docs/
+        DISAGG.md) narrows the candidates to replicas advertising one of
+        those roles when any match — a SOFT preference: an empty match
+        falls back to the whole rotation, because roles are routing
+        affinities, not capabilities, and serving beats shedding."""
         rotation = [r for r in self.membership.in_rotation()
                     if r.id not in tried]
         if not rotation:
             return None, "saturated"
+        if prefer_roles is not None:
+            preferred = [r for r in rotation if r.role in prefer_roles]
+            if preferred:
+                rotation = preferred
         if tried:
             return min(rotation, key=Replica.load_score), "failover"
         if self.policy == "random":
@@ -599,6 +617,29 @@ class RouterHandler(BaseHTTPRequestHandler):
     def _post_completion(self, body: dict, raw: bytes, deadline_ms,
                          tenant_hdrs: dict) -> None:
         state = self.state
+        # kv_source is ROUTER-OWNED (docs/DISAGG.md "Trust model"): a
+        # client-supplied descriptor would make the decode replica fetch
+        # from an arbitrary attacker host (SSRF) and insert the result
+        # into the SHARED prefix cache (cross-request poisoning) — strip
+        # it at the edge unconditionally; only the planner below may
+        # inject one. Durable resumes are unaffected (they re-submit the
+        # journaled entry.body, which keeps the planner's descriptor).
+        if "kv_source" in body:
+            body = dict(body)
+            body.pop("kv_source")
+            raw = json.dumps(body).encode()
+        # prefill/decode disaggregation (docs/DISAGG.md): split BEFORE the
+        # journal opens so the injected kv_source rides the durable body —
+        # a mid-stream failover's resume then re-imports from the prefill
+        # replica (or falls back to a local prefill if it died too). Plan
+        # failures are silent: the request routes monolithic, untouched.
+        if state.disagg.enabled:
+            ks = state.disagg.plan(state.membership, body, tenant_hdrs,
+                                   state.affinity, state.affinity_key(body))
+            if ks is not None:
+                body = dict(body)
+                body["kv_source"] = ks
+                raw = json.dumps(body).encode()
         # trace origination (docs/OBSERVABILITY.md "Request tracing"): adopt
         # the client's W3C traceparent or start a new trace; every proxy try
         # is its own hop (fresh span id, same trace id) stamped onto the
@@ -631,6 +672,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         state = self.state
         t0 = time.perf_counter()
         key = state.affinity_key(body)
+        prefer = state.disagg.prefer_roles(body, state.membership,
+                                           state.affinity, key)
         tried: set[str] = set()
         last_503: tuple[bytes, str, str | None] | None = None
         for attempt in range(1 + state.retries):
@@ -645,7 +688,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                                 "failover", "timeout_error")
                     return
                 extra = {"X-Deadline-Ms": str(int(rem) or 1)}
-            rep, reason = state.pick(key, tried)
+            rep, reason = state.pick(key, tried, prefer)
             if rep is None:
                 break
             tried.add(rep.id)
@@ -703,6 +746,8 @@ class RouterHandler(BaseHTTPRequestHandler):
     def _durable_post_inner(self, entry, ctx) -> None:
         state = self.state
         key = state.affinity_key(entry.body)
+        prefer = state.disagg.prefer_roles(entry.body, state.membership,
+                                           state.affinity, key)
         client_started = [False]
         tried: set[str] = set()
         fruitless = 0
@@ -715,7 +760,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                                    "client deadline expired during failover",
                                    "timeout_error")
                 return
-            rep, reason = state.pick(key, tried)
+            rep, reason = state.pick(key, tried, prefer)
             if rep is None:
                 break
             tried.add(rep.id)
@@ -1136,7 +1181,9 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
                  seed: int = 0, durable: bool = True,
                  tenants: "TenantRegistry | str | None" = None,
                  max_inflight: int = 0,
-                 gate_timeout: float = 30.0) -> ThreadingHTTPServer:
+                 gate_timeout: float = 30.0,
+                 disagg_threshold: int = 0,
+                 disagg_timeout: float = 60.0) -> ThreadingHTTPServer:
     """Build + bind the router (does NOT serve_forever — caller's thread
     choice). Membership is polled once synchronously so the first request
     already has a rotation. `server.router_state` exposes the state.
@@ -1153,7 +1200,9 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
                         affinity_nodes=affinity_nodes, retries=retries,
                         try_timeout=try_timeout, seed=seed, durable=durable,
                         tenants=tenants, max_inflight=max_inflight,
-                        gate_timeout=gate_timeout)
+                        gate_timeout=gate_timeout,
+                        disagg_threshold=disagg_threshold,
+                        disagg_timeout=disagg_timeout)
     membership.start()
     handler = type("BoundRouterHandler", (RouterHandler,),
                    {"state": state, "protocol_version": "HTTP/1.1"})

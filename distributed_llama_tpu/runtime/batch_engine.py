@@ -295,6 +295,13 @@ class BatchRequest:
     # `prompt` are generated-and-delivered-elsewhere tokens, not user prompt —
     # admission counts them separately and the sampler arrives fast-forwarded
     resume_tokens: int = 0
+    # disaggregation export (docs/DISAGG.md): when set, _finish snapshots
+    # the slot's committed prompt-prefix KV blocks to HOST arrays (on the
+    # scheduler thread — the only thread allowed to read device caches)
+    # into kv_export = (tokens, [(k, v) per block], block_tokens) before
+    # done.set(), so the waiting /v1/kv handler can serve them
+    export_kv: bool = False
+    kv_export: tuple | None = None
     # request identity (docs/OBSERVABILITY.md "Request tracing"): `rid` keys
     # the flight-recorder timeline; `ctx` is the W3C trace context captured
     # at submit() — the scheduler thread re-enters it (reqctx.use) around
@@ -624,7 +631,8 @@ class BatchEngine:
                on_token=None, stop_check=None, *, deadline: float | None = None,
                ttl: float | None = None, rid: str | None = None,
                ctx=None, resume_tokens: int = 0, tenant: str = "",
-               klass: str = "interactive") -> BatchRequest:
+               klass: str = "interactive",
+               export_kv: bool = False) -> BatchRequest:
         """Enqueue a request. `deadline` (seconds) bounds the WHOLE request
         (queue + generation; finish reason "deadline", partial output kept);
         `ttl` bounds queue wait only (overrides the engine's queue_ttl).
@@ -684,6 +692,7 @@ class BatchEngine:
         req.tenant = tenant
         req.klass = klass
         req.wfq_cost = cost
+        req.export_kv = export_kv
         if not req.prompt:
             req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
         req.resume_tokens = min(max(int(resume_tokens), 0), len(req.prompt))
@@ -1523,6 +1532,19 @@ class BatchEngine:
             # needs no pin — insert guards its own chain)
             self.prefix_cache.release(slot.lease)
             slot.lease = None
+        if req.export_kv:
+            # disaggregation export (docs/DISAGG.md): host-snapshot the
+            # committed prompt blocks BEFORE done.set() — the /v1/kv
+            # handler wakes on done and must find kv_export populated; and
+            # this runs on the scheduler thread, the only place device
+            # cache reads cannot race a donating dispatch
+            try:
+                req.kv_export = self._export_slot_blocks(
+                    slot, len(req.prompt))
+            except Exception as e:
+                from ..cache import warn_degraded
+
+                warn_degraded("export", e)
         _REQUESTS.labels(finish=finish).inc()
         req.done.set()
         # harvest AFTER done.set(): the slot's history/rows stay valid (they
@@ -1815,6 +1837,66 @@ class BatchEngine:
             from ..cache import warn_degraded
 
             warn_degraded("insert", e)
+
+    def _export_slot_blocks(self, slot: _Slot, prompt_len: int):
+        """Host snapshot of the slot's committed prompt-prefix KV as
+        fixed-size blocks — the disaggregation export payload (docs/
+        DISAGG.md): (tokens, [(k, v) per block], block_tokens), each side
+        an (L, hk, bt, hs) host array. Scheduler thread ONLY: device cache
+        reads must not race a donating dispatch. Only FULL blocks of the
+        prompt export (a partial tail block has no directory home on the
+        importing side); a clamped park truncates the exportable span the
+        same way it truncates the harvest."""
+        bt = self._kv_bt or (self.prefix_cache.block_tokens
+                             if self.prefix_cache is not None else 0)
+        if bt <= 0:
+            return None
+        p = min(prompt_len, len(slot.history))
+        if slot.clamp_pos is not None:
+            p = min(p, slot.clamp_pos)
+        n = p // bt
+        if n == 0:
+            return None
+        tokens = list(slot.history[:n * bt])
+        eng = self._eng
+        if self.kv_pool is not None:
+            blocks = [self._read_block(bid) for bid in slot.blocks[:n]]
+        else:
+            k = np.asarray(eng.k_cache[:, slot.index, :, :n * bt])
+            v = np.asarray(eng.v_cache[:, slot.index, :, :n * bt])
+            blocks = [(k[:, :, i * bt:(i + 1) * bt],
+                       v[:, :, i * bt:(i + 1) * bt]) for i in range(n)]
+        return tokens, blocks, bt
+
+    def import_kv_blocks(self, tokens: list[int], blocks: list) -> int:
+        """Adopt externally-shipped HOST KV blocks (the decode half of a
+        disaggregated admission, docs/DISAGG.md) into the prefix cache:
+        `blocks[i]` is the (k, v) pair covering token block i of `tokens`.
+        Pure host bookkeeping — a paged directory stores them as COLD
+        nodes (the existing admission path pays the one host→device
+        promotion upload, on the scheduler thread), a dense cache inserts
+        them into its host pool (the existing seed scatter applies them) —
+        so this is safe to call from any HTTP handler thread. Returns the
+        token span the cache now covers (0 = nothing imported; the caller
+        admits with a plain local prefill)."""
+        pc = self.prefix_cache
+        if pc is None:
+            return 0
+        bt = pc.block_tokens
+        n = min(len(tokens) // bt, len(blocks))
+        if n <= 0:
+            return 0
+        span = list(tokens[:n * bt])
+        if self.kv_pool is not None:
+            return pc.insert_cold(span, blocks[:n]) * bt
+        k = np.concatenate([np.asarray(b[0]) for b in blocks[:n]], axis=2)
+        v = np.concatenate([np.asarray(b[1]) for b in blocks[:n]], axis=2)
+        pc.insert(span, lambda t0, t1: (k[:, :, t0:t1], v[:, :, t0:t1]))
+        # report what the cache actually HOLDS, not what it was handed: a
+        # lease-pinned-full pool can refuse every block, and claiming the
+        # span anyway would count an "imported" success for KV the
+        # admission must then re-prefill
+        return pc.covered_blocks(span) * bt
 
     def _reap_slots(self) -> None:
         """Free slots whose request was cancelled or whose wall-clock

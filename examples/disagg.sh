@@ -1,0 +1,83 @@
+#!/bin/bash
+# Prefill/decode disaggregation demo (docs/DISAGG.md): a prefill-role and a
+# decode-role replica behind the router with the splitter armed. The long
+# system prompt's prefill runs on the prefill replica, its KV blocks ship
+# over /v1/kv, and the decode replica admits with zero re-prefill of the
+# shipped span — watch the split/import/re-prefill counters at the end.
+set -e
+cd "$(dirname "$0")/.."
+
+MODEL="${DLLAMA_MODEL:-/tmp/dlt_determinism/tiny.m}"
+TOKENIZER="${DLLAMA_TOKENIZER:-/tmp/dlt_determinism/tiny.t}"
+if [ ! -f "$MODEL" ]; then
+  mkdir -p /tmp/dlt_determinism
+  python examples/make_tiny_model.py /tmp/dlt_determinism
+fi
+
+export JAX_PLATFORMS=cpu
+PORT_P="${PORT_P:-9991}"
+PORT_D="${PORT_D:-9992}"
+ROUTER_PORT="${ROUTER_PORT:-9993}"
+
+LOGDIR="$(mktemp -d /tmp/dlt_disagg_demo.XXXXXX)"
+python -m distributed_llama_tpu.apps.api_server \
+  --model "$MODEL" --tokenizer "$TOKENIZER" --chat-template chatml \
+  --host 127.0.0.1 --port "$PORT_P" --batch 2 --superstep 4 \
+  --role prefill >"$LOGDIR/prefill.log" 2>&1 &
+python -m distributed_llama_tpu.apps.api_server \
+  --model "$MODEL" --tokenizer "$TOKENIZER" --chat-template chatml \
+  --host 127.0.0.1 --port "$PORT_D" --batch 2 --superstep 4 \
+  --role decode >"$LOGDIR/decode.log" 2>&1 &
+python -m distributed_llama_tpu.apps.router \
+  --replica "127.0.0.1:$PORT_P" --replica "127.0.0.1:$PORT_D" \
+  --host 127.0.0.1 --port "$ROUTER_PORT" --poll-interval 0.5 \
+  --disagg-threshold 32 >"$LOGDIR/router.log" 2>&1 &
+SERVER_PIDS="$(jobs -p)"
+trap 'kill $SERVER_PIDS 2>/dev/null || true' EXIT
+
+for _ in $(seq 600); do
+  IN_ROT=$(curl -s "http://127.0.0.1:$ROUTER_PORT/healthz" 2>/dev/null |
+    python -c 'import json,sys; print(json.load(sys.stdin).get("in_rotation", 0))' \
+      2>/dev/null || echo 0)
+  [ "$IN_ROT" = "2" ] && break
+  sleep 1
+done
+echo "— fleet up: $IN_ROT replicas (prefill :$PORT_P, decode :$PORT_D)"
+
+LONG_SYSTEM="You are a meticulous assistant. This long system preamble \
+stands in for the retrieval context a production request drags along: the \
+quick brown fox jumps over the lazy dog, again and again and again, while \
+the five boxing wizards jump quickly and the jay, pig, fox, zebra and my \
+wolves quack; sphinx of black quartz, judge my vow."
+
+req() {
+  curl -s "http://127.0.0.1:$ROUTER_PORT/v1/chat/completions" \
+    -H 'Content-Type: application/json' \
+    -d "{\"messages\": [{\"role\": \"system\", \"content\": \"$1\"},
+                        {\"role\": \"user\", \"content\": \"$2\"}],
+         \"max_tokens\": 12, \"temperature\": 0}" >/dev/null
+  echo "  client done: $2"
+}
+
+echo "— long-prompt requests (each splits: prefill replica -> KV wire -> decode replica)"
+req "$LONG_SYSTEM" "summarize the preamble"
+req "$LONG_SYSTEM different tail so nothing is radix-shared $(date +%N)" "and again"
+
+echo "— a short decode chain (below the threshold: routed straight to the decode replica)"
+req "" "just say hi"
+
+echo "— disaggregation counters:"
+curl -s "http://127.0.0.1:$ROUTER_PORT/v1/stats" | python -c '
+import json, sys
+stats = json.load(sys.stdin)
+routes = stats["router"]["metrics"].get("router_disagg_requests_total", {})
+print("  router split decisions:", routes or "(none)")
+for rep_id, st in sorted(stats.get("replicas", {}).items()):
+    m = st.get("metrics") or {}
+    dis = st.get("disagg") or {}
+    pre = m.get("disagg_prefill_requests_total")
+    imp = m.get("disagg_import_requests_total")
+    rep_tok = m.get("disagg_reprefill_tokens_total", 0)
+    print("  replica %s role=%s prefills=%s imports=%s reprefill_tokens=%s"
+          % (rep_id, dis.get("role"), pre, imp, rep_tok))
+'

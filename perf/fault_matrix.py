@@ -71,6 +71,22 @@ DURABILITY_ENGINES = ("pipelined", "speculative")
 DURABILITY_CELLS = len(DURABILITY_ENGINES) * 2 * 2  # × stream × resume
 SUPERVISOR_CELLS = 1  # fault-injected hang -> supervisor recovery
 
+# Disaggregation family (ISSUE 13, docs/DISAGG.md): a role-split fleet
+# (prefill replica + decode replica behind the real router with the
+# splitter armed) where the prefill replica "dies" mid-transfer — every
+# fetch (decode side) or export chunk (prefill side) errors — crossed over
+# {stream, non-stream} × {Q80 wire on, off}. Every cell asserts the
+# documented degradation: the decode replica falls back to a LOCAL prefill
+# with ZERO client-visible failures and byte-identical output (greedy AND
+# seeded-stochastic) vs the monolithic reference, and afterwards neither
+# replica leaks a device block-pool reference, slot, or lease.
+DISAGG_POINTS = ("disagg.fetch", "disagg.export")
+# planner-leg points: a failing plan POST (router side) or /v1/kv prefill
+# admission (replica side) must route the request MONOLITHIC, untouched —
+# one cell each on the raw fleet (wire mode is irrelevant before transfer)
+DISAGG_PLAN_POINTS = ("disagg.plan", "disagg.prefill")
+DISAGG_CELLS = 2 * len(DISAGG_POINTS) * 2 + len(DISAGG_PLAN_POINTS)
+
 # Fairness/starvation family (ISSUE 11, docs/SERVING.md "Multi-tenant
 # serving"): an adversarial flooding tenant saturates the engine's wait
 # queue under ~4x-slots overload while two weighted tenants submit
@@ -804,6 +820,236 @@ def run_durability_family() -> tuple[int, list[str]]:
     return cells, problems
 
 
+# ----------------------------------------------------------------------
+# disaggregation family: role-split fleet, prefill death mid-transfer
+# ----------------------------------------------------------------------
+
+def build_disagg_fleet(q80: bool):
+    """Prefill-role + decode-role replicas (REAL in-process api_servers)
+    behind the REAL router with the splitter armed. Returns
+    (replicas=[(engine, server, port, role)], router, rport, close)."""
+    import threading
+
+    from distributed_llama_tpu.apps.api_server import serve
+    from distributed_llama_tpu.fleet.router import close_router, serve_router
+    from distributed_llama_tpu.formats.mfile import load_model
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.tokenizer import TemplateType
+    from distributed_llama_tpu.tokenizer.bpe import Tokenizer
+
+    mpath, tpath = _fleet_model_files()
+    reps = []
+    for role in ("prefill", "decode"):
+        lspec, lparams = load_model(mpath, 0)
+        be = BatchEngine(lspec, lparams, Tokenizer.load(tpath), slots=2,
+                         tp=1, superstep=4)
+        srv = serve(None, host="127.0.0.1", port=0,
+                    template_type=TemplateType.CHATML, batch_engine=be,
+                    role=role, kv_wire_q80=q80)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        reps.append((be, srv, srv.server_address[1], role))
+    router = serve_router([f"127.0.0.1:{p}" for _, _, p, _ in reps],
+                          host="127.0.0.1", port=0, poll_interval=0.15,
+                          block_bytes=16, retries=2, try_timeout=60.0,
+                          disagg_threshold=24, disagg_timeout=30.0)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+
+    def close():
+        close_router(router)
+        for be, srv, _p, _r in reps:
+            srv.shutdown()
+            srv.server_close()
+            be.close()
+
+    return reps, router, router.server_address[1], close
+
+
+def _disagg_request(rport: int, stream: bool, seed=None,
+                    salt: str = "") -> dict:
+    """One long-prompt completion (over the split threshold) through the
+    router; {text, error, status}. `seed` switches to pinned-seed
+    stochastic sampling (the seeded half of the byte-identity bar).
+    `salt` makes the prompt unique per cell: a Q80-wire split leaves
+    BOUNDED-ERROR KV in the decode replica's directory by design, so a
+    later same-prompt request would legitimately decode from degraded
+    rows — byte-identity cells must not share prompts across wire modes."""
+    body = {"messages": [
+        {"role": "system", "content": "s" * 64},
+        {"role": "user", "content": f"tell me something {salt}"}],
+        "max_tokens": 10, "temperature": 0, "stream": stream}
+    if seed is not None:
+        body.update(temperature=0.9, seed=seed)
+    import http.client
+    import json as _json
+
+    conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+    try:
+        conn.request("POST", "/v1/chat/completions", _json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if not stream:
+            data = _json.loads(resp.read() or b"{}")
+            if resp.status != 200:
+                return {"text": None, "error": data, "status": resp.status}
+            return {"text": data["choices"][0]["message"]["content"],
+                    "error": None, "status": 200}
+        if resp.status != 200:
+            return {"text": None, "error": resp.read().decode(),
+                    "status": resp.status}
+        text, err = [], None
+        for line in resp.read().decode().splitlines():
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            payload = _json.loads(line[6:])
+            if "error" in payload:
+                err = payload["error"]
+                break
+            d = payload["choices"][0]["delta"].get("content")
+            if d:
+                text.append(d)
+        return {"text": "".join(text), "error": err, "status": 200}
+    except Exception as e:
+        return {"text": None, "error": repr(e), "status": None}
+    finally:
+        conn.close()
+
+
+def _disagg_leak_check(be, tag: str) -> list[str]:
+    """Post-family invariants for one replica engine: slots/leases/queue
+    quiesce empty and the device block pool's refcounts BALANCE — every
+    reference is attributable to the pinned scratch block, a slot table
+    entry, or a directory dev node (an imported/exported transfer must not
+    leave a stray pool reference on either side)."""
+    problems: list[str] = []
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with be._plock:
+            leaked = [s for s in be._slots
+                      if s.req is not None or s.lease is not None]
+        if not leaked and not be._pending and be._queue.empty():
+            break
+        time.sleep(0.01)
+    else:
+        problems.append(f"{tag}: slot/lease leak after disagg family")
+        return problems
+    if be.kv_pool is not None:
+        total = int(be.kv_pool.refcounts().sum())
+        slots = sum(len(s.blocks) for s in be._slots)
+        dev_nodes = (be.prefix_cache.stats()["dev_blocks"]
+                     if be.prefix_cache is not None else 0)
+        want = 1 + slots + dev_nodes  # scratch + tables + directory
+        if total != want:
+            problems.append(
+                f"{tag}: block-pool refcount leak (total {total}, "
+                f"accounted {want} = 1 scratch + {slots} slot-table + "
+                f"{dev_nodes} directory)")
+    return problems
+
+
+def run_disagg_family() -> tuple[int, list[str]]:
+    from distributed_llama_tpu.obs import metrics as obs_metrics
+
+    cells = 0
+    problems: list[str] = []
+    for q80 in (False, True):
+        tag = f"disagg-{'q80' if q80 else 'raw'}"
+        reps, router, rport, close = build_disagg_fleet(q80)
+        state = router.router_state
+        try:
+            # non-vacuity: a fault-free request must actually SPLIT (and on
+            # the bit-exact raw wire, still match the monolithic reference)
+            s0 = (obs_metrics.snapshot()
+                  .get("router_disagg_requests_total") or {})
+            r = _disagg_request(rport, stream=False, salt=f"warm-{tag}")
+            s1 = (obs_metrics.snapshot()
+                  .get("router_disagg_requests_total") or {})
+            key = '{outcome="split"}'
+            if (s1.get(key, 0) or 0) <= (s0.get(key, 0) or 0):
+                problems.append(f"{tag}: family vacuous — the fault-free "
+                                "request never split")
+            if not q80:
+                state.disagg.threshold = 0
+                ref = _disagg_request(rport, stream=False,
+                                      salt=f"warm-{tag}")
+                state.disagg.threshold = 24
+                if r["text"] != ref["text"]:
+                    problems.append(
+                        f"{tag}: raw-wire split output diverged "
+                        f"({r['text']!r:.40} vs {ref['text']!r:.40})")
+            for point in DISAGG_POINTS:
+                for stream in (True, False):
+                    cells += 1
+                    name = (f"{tag}/{point}/"
+                            f"{'stream' if stream else 'nonstream'}")
+                    for seed in (None, 777):
+                        # per-cell prompt (see _disagg_request salt note);
+                        # the FAULTED request runs first — its import dies,
+                        # the local-prefill fallback commits BIT-EXACT rows
+                        # — then the monolithic reference, so the identity
+                        # comparison is degraded-path vs clean-path, not
+                        # cache-warmth luck. count=64 outlives every
+                        # per-chunk retry: the prefill replica is
+                        # effectively dead for the whole transfer.
+                        salt = (f"{point[7]}{int(stream)}"
+                                f"{0 if seed is None else 1}{int(q80)}")
+                        with faults.active(FaultSpec(point, kind="error",
+                                                     count=64)):
+                            res = _disagg_request(rport, stream, seed,
+                                                  salt=salt)
+                        faults.uninstall()
+                        if (res["error"] is not None
+                                or res["status"] != 200):
+                            problems.append(f"{name}: client-visible "
+                                            f"failure {res!r}")
+                            continue
+                        state.disagg.threshold = 0
+                        ref = _disagg_request(rport, stream=False,
+                                              seed=seed, salt=salt)
+                        state.disagg.threshold = 24
+                        if res["text"] != ref["text"]:
+                            problems.append(
+                                f"{name}: fallback output diverged "
+                                f"(seed={seed}, {res['text']!r:.40} vs "
+                                f"{ref['text']!r:.40})")
+            if not q80:
+                # planner-leg cells: the split must fail CLOSED into the
+                # monolithic path — same client answer, prefill_error
+                # counted (non-vacuity)
+                for point in DISAGG_PLAN_POINTS:
+                    cells += 1
+                    name = f"{tag}/{point}"
+                    salt = f"p{point[7]}"
+                    e0 = (obs_metrics.snapshot()
+                          .get("router_disagg_requests_total") or {})
+                    with faults.active(FaultSpec(point, kind="error",
+                                                 count=4)):
+                        res = _disagg_request(rport, False, None, salt=salt)
+                    faults.uninstall()
+                    e1 = (obs_metrics.snapshot()
+                          .get("router_disagg_requests_total") or {})
+                    ekey = '{outcome="prefill_error"}'
+                    if res["error"] is not None or res["status"] != 200:
+                        problems.append(f"{name}: client-visible failure "
+                                        f"{res!r}")
+                        continue
+                    if (e1.get(ekey, 0) or 0) <= (e0.get(ekey, 0) or 0):
+                        problems.append(f"{name}: vacuous — no "
+                                        "prefill_error counted")
+                    state.disagg.threshold = 0
+                    ref = _disagg_request(rport, stream=False, salt=salt)
+                    state.disagg.threshold = 24
+                    if res["text"] != ref["text"]:
+                        problems.append(
+                            f"{name}: monolithic-fallback output diverged "
+                            f"({res['text']!r:.40} vs {ref['text']!r:.40})")
+            for be, _srv, port, role in reps:
+                problems += _disagg_leak_check(be, f"{tag}/{role}:{port}")
+        finally:
+            faults.uninstall()
+            close()
+    return cells, problems
+
+
 def run_matrix(include_paged: bool = True,
                kinds=KINDS) -> tuple[int, list[str]]:
     cells = 0
@@ -875,6 +1121,11 @@ def run_matrix(include_paged: bool = True,
     f_cells, f_problems = run_fairness_family()
     cells += f_cells
     problems += f_problems
+    # prefill/decode disaggregation: prefill death mid-transfer must
+    # degrade to a byte-identical local prefill (ISSUE 13, docs/DISAGG.md)
+    g_cells, g_problems = run_disagg_family()
+    cells += g_cells
+    problems += g_problems
     return cells, problems
 
 

@@ -27,7 +27,7 @@ def test_scan_covers_cache_package():
     files = smoke_lint.repo_py_files()
     rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
     for mod in ("radix", "block_pool", "prefix_cache", "single_slot",
-                "device_pool", "__init__"):
+                "device_pool", "wire", "__init__"):
         assert os.path.join("distributed_llama_tpu", "cache",
                             f"{mod}.py") in rel, (mod, sorted(rel)[:5])
     assert os.path.join("perf", "prefix_seed_bench.py") in rel
@@ -40,7 +40,7 @@ def test_scan_covers_fleet_package():
     the compile + dead-import scan."""
     files = smoke_lint.repo_py_files()
     rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
-    for mod in ("router", "membership", "affinity", "__init__"):
+    for mod in ("router", "membership", "affinity", "disagg", "__init__"):
         assert os.path.join("distributed_llama_tpu", "fleet",
                             f"{mod}.py") in rel, mod
     assert os.path.join("distributed_llama_tpu", "apps", "router.py") in rel
