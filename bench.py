@@ -585,13 +585,16 @@ def _fleet_get_json(port, path, timeout=10):
 
 
 def _spawn_fleet_replicas(tmp, mpath, tpath, ports, extra_argv=(),
-                          trace_dir=None, per_replica_argv=None):
+                          trace_dir=None, per_replica_argv=None,
+                          per_replica_env=None):
     """Launch one api_server subprocess per port (tiny fleet checkpoint,
     CPU), env-scrubbed so chaos config never leaks into acceptance
     replicas. Shared by the shared-prefix, chaos, and mixed-context fleet
     benches — the startup machinery must not drift between them.
     `per_replica_argv` adds per-index flags (the mixed-context bench's
-    --role split). Returns (procs, logs)."""
+    --role split); `per_replica_env` overrides env vars per index AFTER
+    the scrub (the gray-failure bench's victim-only sustained-latency
+    DLLAMA_FAULTS). Returns (procs, logs)."""
     import subprocess
 
     repo_root = os.path.dirname(os.path.abspath(__file__))
@@ -602,6 +605,8 @@ def _spawn_fleet_replicas(tmp, mpath, tpath, ports, extra_argv=(),
         log = open(os.path.join(tmp, f"replica_{port}.log"), "w")
         logs.append(log)
         own = tuple(per_replica_argv[i]) if per_replica_argv else ()
+        own_env = (dict(env, **per_replica_env[i])
+                   if per_replica_env and per_replica_env[i] else env)
         argv = [sys.executable, "-m", "distributed_llama_tpu.apps.api_server",
                 "--model", mpath, "--tokenizer", tpath, "--chat-template",
                 "chatml", "--host", "127.0.0.1", "--port", str(port),
@@ -611,7 +616,7 @@ def _spawn_fleet_replicas(tmp, mpath, tpath, ports, extra_argv=(),
             # replica's live buffer into the merged Perfetto file
             argv += ["--trace", os.path.join(trace_dir, f"trace_{port}.json")]
         procs.append(subprocess.Popen(
-            argv, env=env, stdout=log, stderr=subprocess.STDOUT,
+            argv, env=own_env, stdout=log, stderr=subprocess.STDOUT,
             cwd=repo_root))
     return procs, logs
 
@@ -1699,6 +1704,371 @@ def chaos_fleet_workload(args, spec):
             log.close()
 
 
+def chaos_degrade_workload(args, spec):
+    """--workload chaos --replicas N --degrade-replica: the GRAY-failure
+    acceptance bench (docs/FLEET.md "Gray-failure resilience"). Two real
+    fleets run the IDENTICAL seeded schedule through identically-armed
+    routers (probation + adaptive timeouts + bounded hedging): first a
+    healthy baseline, then a fleet whose replica 0 carries a SUSTAINED
+    8-10x request-latency injection (`DLLAMA_FAULTS` duration window in
+    that subprocess only — it answers healthz ok while serving slow, the
+    gray shape the router must detect from outcomes alone). Gates IN-RUN:
+
+    - 0 client-visible failures in the degraded phase;
+    - degraded-fleet TTFT p99 <= 2x the healthy baseline (plus one hedge
+      delay + timer-noise floor — the victim's UN-governed latency is the
+      9x injection, far past the gate either way);
+    - hedge spend within the armed budget (the bench arms a CI-scale
+      budget: in a 2-replica fleet HALF of cold picks hit the victim,
+      nothing like production's 1/N share under the 5% default);
+    - the victim observed ENTERING probation while slow and REJOINING
+      after the injection window expires (canary-driven).
+
+    Emits TTFT/TPOT p50/p95/p99 both ways plus hedge/probation counters in
+    the standard BENCH json."""
+    import http.client
+    import subprocess
+    import tempfile
+    import threading
+
+    from distributed_llama_tpu.fleet.latency import GrayConfig
+    from distributed_llama_tpu.fleet.router import close_router, serve_router
+    from distributed_llama_tpu.obs import metrics as obs_metrics
+
+    n_rep = args.replicas
+    if n_rep < 2:
+        print("❌ --workload chaos --degrade-replica needs --replicas >= 2 "
+              "(a degraded singleton has nowhere to hedge or fail over)",
+              file=sys.stderr)
+        sys.exit(2)
+    n_req = max(args.requests, 24)
+    gen = 16
+    degrade_window_s = 60.0
+
+    def req_body(i):
+        # unique LEADING system prompts: the affinity key is
+        # block-granular, so a shared prefix would pin the whole schedule
+        # to one replica and the victim would see no traffic to be judged
+        # on; greedy AND seeded-stochastic rows, all streaming (TTFT and
+        # TPOT are client-side first-delta/delta-gap timings)
+        return {"messages": [
+            {"role": "system", "content": f"d{i:03d} gray degrade system"},
+            {"role": "user", "content": "ab ab ab ab"}],
+            "max_tokens": gen, "stream": True,
+            "temperature": 0.0 if i % 2 == 0 else 0.8,
+            "seed": 2000 + i}
+
+    def one_request(rport, i, results):
+        t0 = time.perf_counter()
+        ttft = t_first = t_last = None
+        deltas = 0
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", rport,
+                                              timeout=300)
+            conn.request("POST", "/v1/chat/completions",
+                         json.dumps(req_body(i)),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                results[i] = {"error": f"status {resp.status}: "
+                              f"{resp.read()[:160]!r}"}
+                return
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                payload = json.loads(line[6:])
+                if "error" in payload:
+                    results[i] = {"error": payload["error"]}
+                    return
+                if payload["choices"][0]["delta"].get("content"):
+                    now = time.perf_counter()
+                    if ttft is None:
+                        ttft = now - t0
+                        t_first = now
+                    t_last = now
+                    deltas += 1
+            tpot = ((t_last - t_first) / (deltas - 1)
+                    if deltas > 1 else None)
+            results[i] = {"ttft": ttft, "tpot": tpot, "error": None}
+        except Exception as e:
+            results[i] = {"error": repr(e)}
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def warm_replica(port):
+        # direct (router-bypassing) compile warm: a cold XLA build is tens
+        # of seconds on CPU and would smear both phases' percentiles
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        try:
+            for temperature in (0.0, 0.8):
+                conn.request("POST", "/v1/chat/completions", json.dumps({
+                    "messages": [
+                        {"role": "system", "content": "warm system"},
+                        {"role": "user", "content": "ab ab"}],
+                    "max_tokens": 8, "stream": False, "seed": 7,
+                    "temperature": temperature},),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(f"warm of :{port} failed "
+                                       f"({resp.status})")
+        finally:
+            conn.close()
+
+    hedge_pct, hedge_burst = 0.25, 8.0
+
+    def bench_gray_config(hedge_delay):
+        # CI-scale arming: fast detection (6 samples, 3x median), a FIXED
+        # hedge delay (adaptive p95 defers itself when HALF the fleet is
+        # slow — the 2-replica pathology), and a budget sized for a
+        # schedule where ~half of cold picks hit the victim. The delay
+        # must sit ABOVE healthy TTFB (or healthy picks hedge too and
+        # drain the budget the victim picks need) and far below the
+        # injected delay: the degraded phase pins it from the measured
+        # healthy p95.
+        return GrayConfig(eject_multiple=3.0, min_samples=6,
+                          probation_exits=3, canary_every=4,
+                          quorum_frac=0.5, min_lat_samples=12,
+                          hedge=True, hedge_delay=hedge_delay,
+                          hedge_pct=hedge_pct, hedge_burst=hedge_burst)
+
+    def labeled(snap, name):
+        return {k.split('"')[1]: v
+                for k, v in (snap.get(name) or {}).items()}
+
+    def run_phase(label, victim_env, hedge_delay, window_s=0.0):
+        tmp = tempfile.mkdtemp(prefix=f"dlt_gray_{label}_")
+        mpath, tpath = _write_fleet_model(tmp)
+        ports = [_fleet_free_port() for _ in range(n_rep)]
+        procs, logs = _spawn_fleet_replicas(
+            tmp, mpath, tpath, ports,
+            per_replica_env=[victim_env if i == 0 else None
+                             for i in range(n_rep)])
+        router = None
+        out = {"label": label}
+        try:
+            _await_fleet_healthy(procs, ports, tmp)
+            # non-victim replicas warm first: the victim's fault window
+            # starts at ITS first request, so it is warmed last and the
+            # schedule starts immediately after
+            for port in ports[1:]:
+                warm_replica(port)
+            tw = time.perf_counter()
+            warm_replica(ports[0])
+            out["warm_victim_s"] = round(time.perf_counter() - tw, 2)
+            router = serve_router([f"127.0.0.1:{p}" for p in ports],
+                                  host="127.0.0.1", port=0,
+                                  poll_interval=0.3, block_bytes=32,
+                                  retries=2, try_timeout=120.0, durable=True,
+                                  gray=bench_gray_config(hedge_delay))
+            rport = router.server_address[1]
+            threading.Thread(target=router.serve_forever,
+                             daemon=True).start()
+            state = router.router_state
+            victim = state.membership.by_id(f"127.0.0.1:{ports[0]}")
+            probation = {"entered": False, "exited_after_entry": False,
+                         "stop": False}
+
+            def watch():
+                seen = False
+                while not probation["stop"]:
+                    if victim.degraded:
+                        seen = probation["entered"] = True
+                    elif seen:
+                        probation["exited_after_entry"] = True
+                    time.sleep(0.05)
+            watcher = threading.Thread(target=watch, daemon=True)
+            watcher.start()
+
+            results = [None] * n_req
+            sem = threading.Semaphore(3)
+
+            def run_one(i):
+                with sem:
+                    one_request(rport, i, results)
+            snap0 = obs_metrics.snapshot()
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=run_one, args=(i,))
+                       for i in range(n_req)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.perf_counter() - t0
+            if victim_env is not None:
+                # keep outcome evidence flowing until probation entry,
+                # then until the injection window has expired and the
+                # canary trickle rejoins the victim
+                probe_res = {}
+                i = n_req
+                deadline = time.monotonic() + 120
+                while (not probation["entered"]
+                       and time.monotonic() < deadline):
+                    one_request(rport, i, probe_res)
+                    i += 1
+                deadline = time.monotonic() + 120 + window_s
+                while ((victim.degraded or not
+                        probation["exited_after_entry"])
+                       and time.monotonic() < deadline):
+                    one_request(rport, i, probe_res)
+                    i += 1
+                out["probe_requests"] = i - n_req
+                out["probe_failures"] = sum(
+                    1 for r in probe_res.values()
+                    if r is None or r.get("error") is not None)
+            probation["stop"] = True
+            watcher.join(timeout=5)
+            snap1 = obs_metrics.snapshot()
+            hedges0 = labeled(snap0, "router_hedges_total")
+            hedges1 = labeled(snap1, "router_hedges_total")
+            prob0 = labeled(snap0, "router_probation_total")
+            prob1 = labeled(snap1, "router_probation_total")
+            ttfts = sorted(r["ttft"] for r in results
+                           if r and r.get("error") is None
+                           and r.get("ttft") is not None)
+            tpots = sorted(r["tpot"] for r in results
+                           if r and r.get("error") is None
+                           and r.get("tpot") is not None)
+            budget = state.hedge_budget.stats()
+            out.update({
+                "failed": [(i, r) for i, r in enumerate(results)
+                           if r is None or r.get("error") is not None],
+                "wall_s": round(wall, 2),
+                "ttft_p50_ms": _pct_ms(ttfts, 0.50),
+                "ttft_p95_ms": _pct_ms(ttfts, 0.95),
+                "ttft_p99_ms": _pct_ms(ttfts, 0.99),
+                "tpot_p50_ms": _pct_ms(tpots, 0.50),
+                "tpot_p95_ms": _pct_ms(tpots, 0.95),
+                "tpot_p99_ms": _pct_ms(tpots, 0.99),
+                "hedges": {k: int((hedges1.get(k) or 0)
+                                  - (hedges0.get(k) or 0))
+                           for k in ("launched", "won", "denied", "canary")},
+                "probation": {k: int((prob1.get(k) or 0)
+                                     - (prob0.get(k) or 0))
+                              for k in ("enter", "exit")},
+                "hedge_budget": budget,
+                "probation_entered": probation["entered"],
+                "probation_exited": probation["exited_after_entry"],
+                "degraded_roster_now": [r.id for r in
+                                        state.membership.replicas
+                                        if r.degraded],
+            })
+            return out
+        finally:
+            if router is not None:
+                close_router(router)
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=90)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            for log in logs:
+                log.close()
+
+    # healthy baseline: hedge delay parked above any plausible healthy
+    # TTFB on this box (we have no measurement yet; a delay under healthy
+    # latency would hedge ordinary picks)
+    healthy = run_phase("healthy", None, hedge_delay=1.0)
+    if healthy["failed"]:
+        print(f"❌ healthy baseline phase failed: {healthy['failed'][:3]}",
+              file=sys.stderr)
+        sys.exit(1)
+    # sustained 8-10x: the injected stall is ~9x the measured healthy
+    # median request time, floored so it dwarfs CI timer noise
+    delay_ms = max(9.0 * healthy["ttft_p50_ms"], 1000.0)
+    # degraded-phase hedge delay pinned from the MEASURED healthy tail:
+    # above p95 (healthy picks almost never hedge, preserving budget for
+    # victim picks) and far below the injection
+    hedge_delay = min(max(1.5 * healthy["ttft_p95_ms"] / 1000.0, 0.3), 1.5)
+    # the victim's fault window opens at its FIRST request — its own two
+    # compile-warm requests. Size the window from the healthy phase's
+    # MEASURED victim warm (plus the injected stall those warms now pay)
+    # so a slow box cannot burn the injection before the schedule starts
+    window_s = (degrade_window_s + 2.0 * healthy["warm_victim_s"]
+                + 2.0 * delay_ms / 1000.0)
+    degraded = run_phase("degraded", {
+        "DLLAMA_FAULTS":
+            f"api.request:latency:1::{delay_ms:.0f}:{window_s:.0f}",
+        "DLLAMA_FAULT_SEED": "7"}, hedge_delay=hedge_delay,
+        window_s=window_s)
+
+    # the p99 gate: 2x healthy, floored by one hedge delay + p50 service
+    # + timer noise (a hedged victim pick LEGITIMATELY costs delay+service;
+    # on a fast box 2x p99 alone can be smaller than that)
+    gate_ms = max(2.0 * healthy["ttft_p99_ms"],
+                  healthy["ttft_p99_ms"] + hedge_delay * 1000.0 + 400.0)
+    budget = degraded["hedge_budget"]
+    allowance = budget["cap"] + hedge_pct * budget["noted"]
+    print(json.dumps({
+        "metric": "chaos_degrade_ttft_p99_ms",
+        "value": degraded["ttft_p99_ms"], "unit": "ms",
+        "vs_baseline": None,
+        "replicas": n_rep, "requests": n_req, "gen_tokens": gen,
+        "injected_delay_ms": round(delay_ms, 1),
+        "injected_window_s": round(window_s, 1),
+        "hedge_delay_ms": round(hedge_delay * 1000.0, 1),
+        "ttft_gate_ms": round(gate_ms, 2),
+        "healthy": {k: healthy[k] for k in
+                    ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+                     "tpot_p50_ms", "tpot_p95_ms", "tpot_p99_ms",
+                     "wall_s", "hedges")},
+        "degraded": {k: degraded[k] for k in
+                     ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+                      "tpot_p50_ms", "tpot_p95_ms", "tpot_p99_ms",
+                      "wall_s", "hedges", "probation", "probe_requests",
+                      "probe_failures", "probation_entered",
+                      "probation_exited")},
+        "hedge_budget": budget,
+        "hedge_allowance": round(allowance, 2),
+        "failed_requests": len(degraded["failed"]),
+        "failures": [f"{i}: {r}" for i, r in degraded["failed"][:5]],
+    }))
+    # in-run acceptance gates (ISSUE 14)
+    if degraded["failed"] or degraded.get("probe_failures"):
+        print(f"❌ client-visible failures in the degraded phase: "
+              f"{degraded['failed'][:3]} "
+              f"(+{degraded.get('probe_failures', 0)} probe)",
+              file=sys.stderr)
+        sys.exit(1)
+    if degraded["ttft_p99_ms"] > gate_ms:
+        print(f"❌ degraded TTFT p99 {degraded['ttft_p99_ms']}ms over the "
+              f"gate {gate_ms:.0f}ms (healthy p99 "
+              f"{healthy['ttft_p99_ms']}ms)", file=sys.stderr)
+        sys.exit(1)
+    if degraded["hedges"]["launched"] < 1:
+        print("❌ vacuous: no hedge launched in the degraded phase",
+              file=sys.stderr)
+        sys.exit(1)
+    # gate the LAUNCH-SITE counter, not budget["spent"]: TokenBudget keeps
+    # spent <= cap + rate*noted by construction, so gating its own ledger
+    # would be tautological — a regression that launches duplicate tries
+    # without spending a token must still fail here
+    if degraded["hedges"]["launched"] > allowance:
+        print(f"❌ hedges launched {degraded['hedges']['launched']} over "
+              f"the configured allowance {allowance:.1f}", file=sys.stderr)
+        sys.exit(1)
+    if not degraded["probation_entered"]:
+        print("❌ the victim never entered gray-failure probation",
+              file=sys.stderr)
+        sys.exit(1)
+    if not degraded["probation_exited"] or degraded["degraded_roster_now"]:
+        print("❌ the victim never rejoined after the injection window "
+              f"expired (roster {degraded['degraded_roster_now']})",
+              file=sys.stderr)
+        sys.exit(1)
+
+
 def trace_workload(args, spec):
     """--workload trace: the multi-tenant SLO acceptance bench
     (docs/SERVING.md "Multi-tenant serving"). A seeded trace-driven load
@@ -2170,6 +2540,14 @@ def main():
                          "the measured phase — graceful drain + router "
                          "failover must complete every request (exit 1 on any "
                          "client-visible failure)")
+    ap.add_argument("--degrade-replica", action="store_true",
+                    help="chaos fleet workload: run the identical schedule "
+                         "against a healthy fleet and one whose replica 0 "
+                         "serves under a sustained 8-10x injected latency "
+                         "while answering healthz ok (the GRAY failure, "
+                         "docs/FLEET.md) — gates 0 failures, TTFT p99 <= 2x "
+                         "healthy, hedge spend in budget, probation "
+                         "entry + rejoin")
     ap.add_argument("--shared-prefix", type=int, default=192, metavar="T",
                     help="shared-prefix workload: tokens in the common system "
                          "prompt (clamped to fit seq_len)")
@@ -2263,10 +2641,20 @@ def main():
                  "against")
     if args.kill_replica and not args.replicas:
         ap.error("--kill-replica requires --replicas N")
-    if args.workload == "chaos" and args.replicas and not args.kill_replica:
-        ap.error("--workload chaos --replicas N is the mid-stream "
-                 "replica-kill mode: add --kill-replica (the in-process "
-                 "fault-rate chaos bench takes no --replicas)")
+    if args.degrade_replica and (args.workload != "chaos"
+                                 or not args.replicas):
+        ap.error("--degrade-replica is the gray-failure mode of "
+                 "--workload chaos --replicas N (docs/FLEET.md "
+                 "\"Gray-failure resilience\")")
+    if args.degrade_replica and args.kill_replica:
+        ap.error("--degrade-replica and --kill-replica are separate "
+                 "chaos modes; run them as two bench invocations")
+    if (args.workload == "chaos" and args.replicas
+            and not args.kill_replica and not args.degrade_replica):
+        ap.error("--workload chaos --replicas N needs a fleet chaos mode: "
+                 "--kill-replica (mid-stream SIGKILL + durable resume) or "
+                 "--degrade-replica (sustained gray degradation); the "
+                 "in-process fault-rate chaos bench takes no --replicas)")
     if args.trace_fleet and not args.replicas:
         ap.error("--trace-fleet requires --replicas N (the fleet tier of "
                  "--workload shared-prefix)")
@@ -2403,7 +2791,12 @@ def main():
             shared_prefix_workload(args, spec)
         return
     if args.workload == "chaos":
-        if args.replicas >= 1:
+        if args.replicas >= 1 and args.degrade_replica:
+            # gray-failure fleet chaos (docs/FLEET.md "Gray-failure
+            # resilience"): identical schedule vs a healthy fleet and one
+            # with a sustained-slow replica — probation + hedging gated
+            chaos_degrade_workload(args, spec)
+        elif args.replicas >= 1:
             # fleet chaos (docs/FLEET.md "Resume protocol"): real replica
             # subprocesses + the durable router, SIGKILL one mid-stream —
             # every request must complete with resumed outputs byte-identical

@@ -381,7 +381,10 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
     Raises typed resilience errors BEFORE any generation work so the HTTP
     layer can map them to honest status codes (InvalidRequest -> 400,
     EngineDraining/EngineSaturated -> 503, DeadlineExceeded -> 408)."""
-    faults.fire("api.request")
+    # the replica ctx lets a fault plan target ONE replica of an in-process
+    # fleet (match={"replica": id}) — e.g. the gray-failure family's
+    # sustained-latency injection (docs/ROBUSTNESS.md "Gray failures")
+    faults.fire("api.request", replica=state.replica_id)
     if state.draining:
         raise EngineDraining("server is draining (shutting down)")
     rc = reqctx.current()

@@ -17,6 +17,7 @@ import argparse
 import signal
 import threading
 
+from ..fleet.latency import GrayConfig
 from ..fleet.router import close_router, serve_router
 from ..resilience import faults
 
@@ -59,7 +60,72 @@ def build_parser() -> argparse.ArgumentParser:
                         "pass-through where mid-stream failures surface as "
                         "SSE error events")
     p.add_argument("--proxy-timeout", type=float, default=120.0, metavar="S",
-                   help="per-try socket timeout (connect and each read)")
+                   help="per-try timeout CEILING: the adaptive "
+                        "pre-first-byte timeout and the stream idle-gap "
+                        "timeout are both clamped to at most this "
+                        "(docs/FLEET.md \"Gray-failure resilience\")")
+    # gray-failure resilience (docs/FLEET.md "Gray-failure resilience"):
+    # adaptive timeouts, bounded hedging, probation, retry budget
+    p.add_argument("--ttfb-timeout-floor", type=float, default=5.0,
+                   metavar="S",
+                   help="lower clamp of the adaptive pre-first-byte "
+                        "timeout (derived from observed fleet TTFB p95; "
+                        "the --proxy-timeout cap applies until enough "
+                        "samples exist)")
+    p.add_argument("--ttfb-timeout-cap", type=float, default=None,
+                   metavar="S",
+                   help="upper clamp of the adaptive pre-first-byte "
+                        "timeout (default: --proxy-timeout). Set equal to "
+                        "the floor to pin a fixed TTFB timeout")
+    p.add_argument("--idle-timeout", type=float, default=0.0, metavar="S",
+                   help="stream idle-gap timeout: how long one body read "
+                        "may block mid-stream before the replica counts as "
+                        "wedged (durable routing resumes the stream "
+                        "elsewhere). 0 = adaptive from observed per-event "
+                        "pace, floored at 10 s, capped at --proxy-timeout")
+    p.add_argument("--no-hedge", action="store_true",
+                   help="disable pre-first-byte request hedging (by "
+                        "default a try quiet past ~fleet TTFB p95 races a "
+                        "budget-bounded duplicate on another replica; "
+                        "first byte wins, the loser is canceled)")
+    p.add_argument("--hedge-delay", type=float, default=0.0, metavar="S",
+                   help="fixed hedge delay; 0 = adaptive (~observed fleet "
+                        "TTFB p95). Pin it in tiny fleets where a slow "
+                        "replica carries a large share of the samples and "
+                        "the adaptive p95 would defer the hedge past the "
+                        "latency it exists to cut")
+    p.add_argument("--hedge-budget-pct", type=float, default=5.0,
+                   metavar="PCT",
+                   help="hedge spend bound: duplicate tries may not exceed "
+                        "this percentage of proxied tries (plus a small "
+                        "burst) — hedging can never melt an overloaded "
+                        "fleet")
+    p.add_argument("--retry-budget-ratio", type=float, default=0.5,
+                   metavar="R",
+                   help="failover retry budget: tokens added per delivered "
+                        "completion (each failover retry spends one; an "
+                        "empty bucket sheds instead of storming)")
+    p.add_argument("--retry-budget-cap", type=float, default=16.0,
+                   metavar="N",
+                   help="failover retry budget burst cap (the bucket "
+                        "starts full)")
+    p.add_argument("--eject-multiple", type=float, default=4.0, metavar="X",
+                   help="gray-failure probation: a replica whose observed "
+                        "TTFB p50 is at least X times its peers' median "
+                        "leaves rotation for canary-only probation")
+    p.add_argument("--eject-min-samples", type=int, default=20, metavar="N",
+                   help="per-replica TTFB samples required before the "
+                        "outlier detector may judge it")
+    p.add_argument("--probation-canaries", type=int, default=3, metavar="N",
+                   help="consecutive in-band canary responses required for "
+                        "a degraded replica to rejoin rotation")
+    p.add_argument("--canary-every", type=int, default=8, metavar="N",
+                   help="route every Nth pick to a degraded replica "
+                        "(the probation canary trickle)")
+    p.add_argument("--quorum-frac", type=float, default=0.5, metavar="F",
+                   help="never eject below ceil(F x healthy replicas): a "
+                        "uniformly slow fleet degrades honestly instead of "
+                        "ejecting everyone")
     p.add_argument("--tenants", default=None, metavar="SPEC",
                    help="router-level multi-tenant policy (docs/SERVING.md "
                         "\"Multi-tenant serving\"): ';'-separated "
@@ -109,6 +175,20 @@ def main(argv=None) -> None:
         from ..obs import trace as obs_trace
 
         tracer = obs_trace.install(process_name="router")
+    gray = GrayConfig(
+        eject_multiple=args.eject_multiple,
+        min_samples=args.eject_min_samples,
+        probation_exits=args.probation_canaries,
+        quorum_frac=args.quorum_frac,
+        canary_every=args.canary_every,
+        ttfb_floor=args.ttfb_timeout_floor,
+        ttfb_cap=args.ttfb_timeout_cap,
+        idle_timeout=args.idle_timeout,
+        hedge=not args.no_hedge,
+        hedge_delay=args.hedge_delay,
+        hedge_pct=args.hedge_budget_pct / 100.0,
+        retry_ratio=args.retry_budget_ratio,
+        retry_cap=args.retry_budget_cap)
     server = serve_router(
         args.replicas, host=args.host, port=args.port, policy=args.routing,
         poll_interval=args.poll_interval, poll_timeout=args.poll_timeout,
@@ -117,7 +197,7 @@ def main(argv=None) -> None:
         durable=not args.no_durable, tenants=args.tenants,
         max_inflight=args.max_inflight, gate_timeout=args.gate_timeout,
         disagg_threshold=args.disagg_threshold,
-        disagg_timeout=args.disagg_timeout)
+        disagg_timeout=args.disagg_timeout, gray=gray)
 
     def _on_term(signum, frame):
         # the router holds no request state worth draining beyond in-flight

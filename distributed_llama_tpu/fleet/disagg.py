@@ -54,6 +54,7 @@ from http.client import HTTPConnection
 
 from ..obs import metrics, trace
 from ..resilience import faults
+from .latency import LatencyStat
 from .membership import Membership, Replica, parse_addr
 
 __all__ = ["ROLES", "PREFILL_ROLES", "DECODE_ROLES", "DisaggPlanner",
@@ -96,6 +97,12 @@ _IMPORT_BYTES = metrics.counter(
 _IMPORT_SECONDS = metrics.histogram(
     "disagg_import_seconds",
     "Wall time of one kv_source import (all chunk fetches + host insert)")
+_FETCH_HEDGES = metrics.counter(
+    "disagg_fetch_hedges_total",
+    "Duplicate KV-chunk fetches raced against a chunk quiet past the "
+    "adaptive soft deadline (ranges are independently re-fetchable and "
+    "idempotent, so first result wins — the gray-failure hedging idiom "
+    "applied to the transfer leg)")
 _REPREFILL = metrics.counter(
     "disagg_reprefill_tokens_total",
     "Shipped-span tokens a disaggregated admission re-prefilled anyway "
@@ -391,6 +398,71 @@ def fetch_kv_blocks(host: str, port: int, xfer_id: str, frm: int, n: int,
     return blocks
 
 
+def _fetch_chunk_hedged(host: str, port: int, xfer_id: str, frm: int,
+                        n: int, *, timeout: float,
+                        chunk_lat: LatencyStat) -> list:
+    """One chunk fetch with the gray-failure treatment (docs/FLEET.md
+    "Gray-failure resilience") applied to the transfer leg: once earlier
+    chunks of this import have landed, the per-chunk timeout TIGHTENS to a
+    multiple of the observed chunk time (capped at the configured
+    `timeout` — a prefill replica that served chunk 1 in 30 ms should not
+    get 30 s to wedge on chunk 2), and a fetch quiet past the adaptive
+    soft deadline races ONE duplicate fetch of the same range — ranges are
+    independently re-fetchable and idempotent, so first result wins and
+    the loser is discarded. The first chunk (no evidence yet) runs plain.
+    Raises when every attempt failed; the caller's per-chunk retry and the
+    local-prefill fallback keep the failure semantics unchanged."""
+    if chunk_lat.count() == 0:
+        t0 = time.perf_counter()
+        out = fetch_kv_blocks(host, port, xfer_id, frm, n, timeout=timeout)
+        chunk_lat.note(time.perf_counter() - t0)
+        return out
+    soft = min(max(4.0 * chunk_lat.ewma(), 0.25), timeout)
+    hard = min(max(4.0 * soft, 1.0), timeout)
+    cv = threading.Condition()
+    state: dict = {"ok": None, "errs": 0, "started": 1, "err": None}
+
+    def settled() -> bool:
+        return state["ok"] is not None or state["errs"] >= state["started"]
+
+    def attempt():
+        t0 = time.perf_counter()
+        try:
+            got = fetch_kv_blocks(host, port, xfer_id, frm, n, timeout=hard)
+            chunk_lat.note(time.perf_counter() - t0)
+            with cv:
+                if state["ok"] is None:
+                    state["ok"] = got
+                cv.notify_all()
+        except Exception as e:
+            with cv:
+                state["errs"] += 1
+                state["err"] = e
+                cv.notify_all()
+
+    threading.Thread(target=attempt, daemon=True, name="kv-fetch").start()
+    with cv:
+        cv.wait_for(settled, timeout=soft)
+        hedge = not settled()
+        if hedge:
+            state["started"] += 1
+    if hedge:
+        _FETCH_HEDGES.inc()
+        threading.Thread(target=attempt, daemon=True,
+                         name="kv-fetch-hedge").start()
+    with cv:
+        # final wait bounded by the CONFIGURED cap, not the tightened
+        # per-socket-op deadline: `hard` bounds each read/connect inside
+        # fetch_kv_blocks, but a multi-read chunk making steady progress
+        # may legitimately take longer in total than one op's budget
+        if not cv.wait_for(settled, timeout=timeout + 1.0):
+            raise TimeoutError(f"kv fetch {xfer_id}[{frm}:{frm + n}] "
+                               f"timed out after {timeout:.1f}s")
+        if state["ok"] is not None:
+            return state["ok"]
+        raise state["err"]
+
+
 def import_kv_source(engine, prompt: list[int], ks: dict, *,
                      timeout: float = 30.0, chunk_blocks: int = 4) -> int:
     """Pull a ``kv_source`` transfer into `engine`'s prefix cache; returns
@@ -423,6 +495,7 @@ def import_kv_source(engine, prompt: list[int], ks: dict, *,
         _IMPORTS.labels(outcome="hash_mismatch").inc()
         return 0
     blocks: list = []
+    chunk_lat = LatencyStat(window=16)  # per-import chunk-time evidence
     try:
         with trace.span("disagg.import",
                         {"xfer": xfer_id, "blocks": n_blocks}):
@@ -430,9 +503,21 @@ def import_kv_source(engine, prompt: list[int], ks: dict, *,
                 want = min(max(chunk_blocks, 1), n_blocks - frm)
                 for attempt in (0, 1):  # per-chunk retry: resumable ranges
                     try:
-                        blocks.extend(
-                            fetch_kv_blocks(host, port, xfer_id, frm,
-                                            want, timeout=timeout))
+                        if attempt == 0:
+                            blocks.extend(_fetch_chunk_hedged(
+                                host, port, xfer_id, frm, want,
+                                timeout=timeout, chunk_lat=chunk_lat))
+                        else:
+                            # the retry runs UN-tightened, with the full
+                            # configured timeout: the hedged attempt's
+                            # EWMA-derived deadline may be exactly why the
+                            # first try failed (a transient server-side
+                            # stall after fast chunks), and a retry that
+                            # can only repeat the same deadline could
+                            # never succeed where slowness failed
+                            blocks.extend(fetch_kv_blocks(
+                                host, port, xfer_id, frm, want,
+                                timeout=timeout))
                         break
                     except Exception:
                         if attempt:

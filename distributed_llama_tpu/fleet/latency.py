@@ -1,0 +1,320 @@
+"""Gray-failure resilience primitives: latency tracking, probation, budgets.
+
+The hard failures PR 9 survives (SIGKILL, wedged engine) announce themselves:
+a dead socket, an unhealthy /healthz. The *gray* failure does not — a replica
+that answers every health poll while decoding 10x slow (GC pauses, thermal
+throttling, a lossy NIC, one contended core) passes membership's checks and
+silently drags fleet-wide tail latency, because routing reads only the polled
+queue-depth block and every proxy try shares one fixed 120 s socket timeout.
+This module is the dependency-free measurement + policy layer the router
+threads through the fleet tier (docs/FLEET.md "Gray-failure resilience"):
+
+- **LatencyStat** — a windowed streaming estimator (ring of the last N
+  samples for on-demand quantiles, plus an EWMA) fed by REAL proxy outcomes:
+  TTFB per try, per-token pace per relayed stream event, healthz round-trip
+  per membership poll. No numpy — the router process stays stdlib-only.
+- **GrayFailureDetector** — outlier ejection with probation: a replica whose
+  observed TTFB is a configurable multiple of its PEERS' median leaves
+  normal rotation into a `degraded` state, keeps receiving a trickle of
+  canary traffic, and rejoins only after N consecutive in-band canaries.
+  A quorum floor stops the detector from ejecting a uniformly-slow fleet
+  below `quorum_frac` of its healthy replicas — uniform slowness degrades
+  honestly instead of shedding everything.
+- **TokenBudget** — the spend governor behind request hedging and failover
+  retries: tokens accrue from observed work (a fraction per try / per
+  success) up to a cap, and each hedge/retry spends one. Under overload the
+  budget drains and the failover machinery stops amplifying load into a
+  retry storm; under normal traffic it is never the binding constraint.
+
+Policy knobs live in **GrayConfig** (one object, wired from apps/router.py
+flags) so the fault matrix and the chaos bench can arm aggressive variants
+without growing serve_router's signature per knob.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..obs import metrics
+
+__all__ = ["LatencyStat", "ReplicaLatency", "TokenBudget", "GrayConfig",
+           "GrayFailureDetector"]
+
+_DEGRADED = metrics.gauge(
+    "router_replicas_degraded",
+    "Replicas currently in gray-failure probation (out of normal rotation, "
+    "receiving canary traffic only)")
+_PROBATION = metrics.counter(
+    "router_probation_total",
+    "Gray-failure probation transitions (docs/FLEET.md): enter = TTFB "
+    "outlier ejected from rotation, exit = rejoined after consecutive "
+    "in-band canaries", labelnames=("event",))
+_QUORUM_HELD = metrics.counter(
+    "router_probation_quorum_held_total",
+    "Ejections the detector SKIPPED because they would drop rotation below "
+    "the quorum floor (a uniformly slow fleet must degrade honestly, not "
+    "shed itself empty)")
+
+
+class LatencyStat:
+    """Windowed streaming latency estimator: a ring of the last `window`
+    samples (quantiles computed on demand over a snapshot) plus a decayed
+    EWMA. Sample counts are monotonic; the window bounds memory and keeps
+    quantiles RECENT — a replica that recovered an hour ago must not be
+    judged on last hour's tail."""
+
+    def __init__(self, window: int = 128, alpha: float = 0.2):
+        assert window >= 4 and 0.0 < alpha <= 1.0
+        self._window = window
+        self._alpha = alpha
+        self._lock = threading.Lock()  # guards: _ring, _n, _ewma
+        self._ring: list[float] = []
+        self._n = 0          # total samples ever noted
+        self._ewma = 0.0
+
+    def note(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            if len(self._ring) < self._window:
+                self._ring.append(v)
+            else:
+                self._ring[self._n % self._window] = v
+            self._n += 1
+            self._ewma = (v if self._n == 1
+                          else self._ewma + self._alpha * (v - self._ewma))
+
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def ewma(self) -> float:
+        with self._lock:
+            return self._ewma
+
+    def quantile(self, q: float) -> float | None:
+        """q-quantile over the current window; None before any sample."""
+        with self._lock:
+            if not self._ring:
+                return None
+            data = sorted(self._ring)
+        idx = min(int(q * len(data)), len(data) - 1)
+        return data[max(idx, 0)]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._n = 0
+            self._ewma = 0.0
+
+
+class ReplicaLatency:
+    """Per-replica outcome signals, one LatencyStat each (each stat carries
+    its own lock, so note paths from proxy handler threads and the poller
+    never contend on a shared structure):
+
+    - `ttfb`: seconds from issuing the upstream request to its response
+      headers (api_server defers SSE headers to the first delta, so this IS
+      first-byte time, queue wait included) — the primary gray signal;
+    - `pace`: per-event inter-arrival gap while relaying a stream — catches
+      the replica that starts fast and decodes slow;
+    - `health_rtt`: /healthz round-trip from the membership poller — a
+      latency signal that exists BEFORE any traffic flows (load_score
+      tie-break), and the tie-break between two idle replicas."""
+
+    __slots__ = ("ttfb", "pace", "health_rtt")
+
+    def __init__(self):
+        self.ttfb = LatencyStat(window=128)
+        self.pace = LatencyStat(window=256)
+        self.health_rtt = LatencyStat(window=32)
+
+    def snapshot_ms(self) -> dict:
+        """Rounded-ms view for /healthz // /v1/stats (None = no samples)."""
+        def ms(v):
+            return None if v is None else round(v * 1000.0, 2)
+        return {"ttfb_p50_ms": ms(self.ttfb.quantile(0.5)),
+                "ttfb_p95_ms": ms(self.ttfb.quantile(0.95)),
+                "pace_p95_ms": ms(self.pace.quantile(0.95)),
+                "health_rtt_ms": (None if self.health_rtt.count() == 0
+                                  else ms(self.health_rtt.ewma()))}
+
+
+class TokenBudget:
+    """Work-proportional spend governor (hedges, failover retries). Tokens
+    accrue at `rate` per note() up to `cap`; each spend() takes one whole
+    token. Starts FULL: a cold router must still be able to fail over (the
+    budget bounds storms, it does not ration the first incident)."""
+
+    def __init__(self, rate: float, cap: float):
+        assert rate >= 0.0 and cap >= 1.0
+        self.rate = rate
+        self.cap = float(cap)
+        self._lock = threading.Lock()  # guards: _tokens, _spent, _noted
+        self._tokens = float(cap)
+        self._spent = 0
+        self._noted = 0
+
+    def note(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._noted += 1
+            self._tokens = min(self._tokens + self.rate * n, self.cap)
+
+    def spend(self) -> bool:
+        with self._lock:
+            if self._tokens < 1.0:
+                return False
+            self._tokens -= 1.0
+            self._spent += 1
+            return True
+
+    def level(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tokens": round(self._tokens, 3), "cap": self.cap,
+                    "rate": self.rate, "spent": self._spent,
+                    "noted": self._noted}
+
+
+@dataclass
+class GrayConfig:
+    """Gray-failure policy knobs (docs/FLEET.md "Gray-failure resilience").
+    One object instead of a dozen serve_router parameters; apps/router.py
+    builds it from flags, the fault matrix and chaos bench arm aggressive
+    variants directly."""
+
+    # outlier ejection / probation
+    eject_multiple: float = 4.0   # degraded when TTFB p50 >= this x peer median
+    min_samples: int = 20         # per-replica TTFB samples before judging
+    probation_exits: int = 3      # consecutive in-band canaries to rejoin
+    quorum_frac: float = 0.5      # never eject below ceil(frac x healthy)
+    canary_every: int = 8         # every Nth first-try pick canaries a degraded replica
+    # adaptive timeouts (pre-first-byte vs stream idle gap)
+    ttfb_floor: float = 5.0       # adaptive TTFB timeout lower clamp (s)
+    ttfb_cap: float | None = None  # upper clamp; None = the --proxy-timeout cap
+    ttfb_mult: float = 6.0        # timeout = mult x observed fleet TTFB p95
+    idle_timeout: float = 0.0     # fixed stream idle-gap timeout; 0 = adaptive
+    idle_floor: float = 10.0      # adaptive idle-gap lower clamp (s)
+    idle_mult: float = 50.0       # idle = mult x observed fleet pace p99
+    min_lat_samples: int = 32     # fleet samples before timeouts/hedges adapt
+    # bounded request hedging (pre-first-byte duplicate try)
+    hedge: bool = True
+    hedge_pct: float = 0.05       # budget accrual: extra tries <= pct of tries (+burst)
+    hedge_burst: float = 4.0      # budget cap (also the cold-start allowance)
+    hedge_floor: float = 0.05     # minimum hedge delay (s)
+    # fixed hedge delay override (s); 0 = adaptive (~fleet TTFB p95). The
+    # adaptive delay is right when slow replicas are a small minority; in a
+    # tiny fleet where one of two replicas is slow, HALF the samples are
+    # slow and p95-based hedging defers itself — pin the delay instead.
+    hedge_delay: float = 0.0
+    # global failover retry budget (refilled by successes)
+    retry_ratio: float = 0.5      # tokens added per delivered completion
+    retry_cap: float = 16.0
+
+
+class GrayFailureDetector:
+    """Outlier ejection with probation over Membership's replicas.
+
+    `evaluate` runs on the membership poll thread (periodic, low rate);
+    `note_outcome` runs on proxy handler threads after every successful
+    upstream open. Both read per-replica LatencyStat objects (self-locked)
+    and mutate replica probation state through the Replica's own lock-held
+    methods, so there is no detector-owned shared mutable state beyond the
+    metrics counters."""
+
+    def __init__(self, cfg: GrayConfig):
+        self.cfg = cfg
+
+    # -- fleet statistics ----------------------------------------------
+
+    def _peer_median_ttfb(self, rep, replicas) -> float | None:
+        """Median of the OTHER candidate replicas' TTFB p50s. Peers exclude
+        the judged replica (with 2 replicas a self-inclusive median could
+        never flag anything: no member exceeds 2x a median it is half of)
+        and exclude already-degraded replicas (their slowness must not
+        drag the baseline toward them)."""
+        p50s = []
+        for r in replicas:
+            if r is rep or not r.healthy or r.draining or r.degraded:
+                continue
+            if r.lat.ttfb.count() >= self.cfg.min_samples:
+                q = r.lat.ttfb.quantile(0.5)
+                if q is not None:
+                    p50s.append(q)
+        if not p50s:
+            return None
+        p50s.sort()
+        return p50s[len(p50s) // 2]
+
+    def _quorum_floor(self, replicas) -> int:
+        healthy = sum(1 for r in replicas if r.healthy and not r.draining)
+        return max(int(self.cfg.quorum_frac * healthy + 0.999), 1)
+
+    # -- probation entry (poll thread) ---------------------------------
+
+    def evaluate(self, replicas) -> None:
+        """One detection pass: flag TTFB outliers, respecting the quorum
+        floor. Exit is canary-driven (note_outcome), never time-driven — a
+        replica rejoins because it MEASURED healthy, not because it waited."""
+        floor = self._quorum_floor(replicas)
+        for rep in replicas:
+            if rep.degraded or not rep.healthy or rep.draining:
+                continue
+            if rep.lat.ttfb.count() < self.cfg.min_samples:
+                continue
+            peer_median = self._peer_median_ttfb(rep, replicas)
+            if peer_median is None or peer_median <= 0.0:
+                continue
+            p50 = rep.lat.ttfb.quantile(0.5)
+            if p50 is None or p50 < self.cfg.eject_multiple * peer_median:
+                continue
+            # count what is actually ROUTABLE right now: a replica sitting
+            # out a Retry-After cooldown is healthy but not in rotation,
+            # and the floor's promise is about where traffic can GO
+            in_rotation = sum(1 for r in replicas
+                              if r.healthy and not r.draining
+                              and not r.degraded and not r.in_cooldown())
+            if in_rotation - 1 < floor:
+                _QUORUM_HELD.inc()
+                continue
+            if rep.set_degraded(True):
+                _PROBATION.labels(event="enter").inc()
+                print(f"🟡 replica {rep.id} entering gray-failure probation "
+                      f"(TTFB p50 {p50 * 1000:.0f}ms >= "
+                      f"{self.cfg.eject_multiple:g}x peer median "
+                      f"{peer_median * 1000:.0f}ms); canary traffic only")
+        _DEGRADED.set(sum(1 for r in replicas if r.degraded))
+
+    # -- probation exit (proxy outcome path) ---------------------------
+
+    def note_outcome(self, rep, ttfb_s: float, replicas) -> None:
+        """Fold one successful upstream open's TTFB into probation state:
+        for a degraded replica, an in-band canary (TTFB back under the
+        ejection threshold vs its peers) counts toward rejoin; an
+        out-of-band one resets the streak."""
+        if not rep.degraded:
+            return
+        peer_median = self._peer_median_ttfb(rep, replicas)
+        if peer_median is None:
+            # no peer baseline (peers draining/unjudged): the canary can't
+            # be JUDGED, so it must not advance the rejoin streak — a
+            # still-slow replica would otherwise walk out of probation the
+            # moment its peers stop being comparable. (An emptied rotation
+            # still serves: pick() falls back to canary_candidates.)
+            return
+        in_band = ttfb_s < self.cfg.eject_multiple * peer_median
+        streak = rep.canary_note(in_band)
+        if in_band and streak >= self.cfg.probation_exits:
+            # rejoin: the window still holds probation-era samples, so a
+            # fresh detection pass must start from the replica's NEW
+            # behavior, not re-eject it on stale tail
+            rep.lat.ttfb.reset()
+            rep.lat.pace.reset()
+            if rep.set_degraded(False):
+                _PROBATION.labels(event="exit").inc()
+                print(f"🟢 replica {rep.id} rejoined from probation "
+                      f"({streak} consecutive in-band canaries)")
+            _DEGRADED.set(sum(1 for r in replicas if r.degraded))

@@ -33,6 +33,7 @@ from http.client import HTTPConnection
 
 from ..obs import metrics
 from ..resilience import faults
+from .latency import ReplicaLatency
 
 __all__ = ["Replica", "Membership"]
 
@@ -94,6 +95,20 @@ class Replica:
     consecutive_failures: int = 0
     last_ok: float = 0.0
     hash_warned: bool = False  # rate-limits the model-mismatch warning
+    # gray-failure resilience (docs/FLEET.md "Gray-failure resilience"):
+    # `degraded` is ROUTER-SIDE probation state — the replica answers healthz
+    # ok but its observed TTFB is an outlier vs its peers, so it leaves
+    # normal rotation and serves canary traffic only until `canary_ok`
+    # consecutive in-band outcomes clear it (fleet/latency.py detector).
+    # `retry_after_until` is the Retry-After cooldown a replica's own 503
+    # asked for: pick() skips the replica until the window passes (or a
+    # clean idle poll shows the saturation cleared).
+    degraded: bool = False
+    canary_ok: int = 0
+    retry_after_until: float = 0.0  # monotonic; 0 = no cooldown
+    # outcome-driven latency signals (TTFB / stream pace / healthz RTT);
+    # the stats self-lock, only the reference lives here
+    lat: ReplicaLatency = field(default_factory=ReplicaLatency, repr=False)
     # per-replica poll backoff (unreachable replicas only): the background
     # poller skips this replica until next_poll_t — exponential with jitter,
     # so a dead replica costs ~one timed-out connect per backoff_cap instead
@@ -101,7 +116,7 @@ class Replica:
     next_poll_t: float = 0.0       # monotonic; 0 = poll normally
     down_since: float = 0.0        # monotonic of the first failed poll
     last_down_log: float = 0.0     # rate-limits the "still down" line
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)  # guards: healthy, draining, status, consecutive_failures, slots, free_slots, queue_depth, model_hash, pid, uptime_s, inflight, last_ok, role
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)  # guards: healthy, draining, status, consecutive_failures, slots, free_slots, queue_depth, model_hash, pid, uptime_s, inflight, last_ok, role, degraded, canary_ok, retry_after_until
 
     def __post_init__(self):
         if not self.id:
@@ -109,21 +124,65 @@ class Replica:
 
     def load_score(self) -> tuple:
         """Least-loaded ordering: fewest waiting+in-flight first, then most
-        free slots, then id for determinism."""
+        free slots, then the polled healthz round-trip in 10 ms buckets (a
+        latency signal that exists before any traffic flows — two idle
+        replicas tie-break toward the faster network/process, and the
+        bucketing keeps micro-jitter from destabilizing the order), then id
+        for determinism."""
         with self._lock:
             return (self.queue_depth + self.inflight, -self.free_slots,
-                    self.id)
+                    int(self.lat.health_rtt.ewma() * 100.0), self.id)
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"id": self.id, "healthy": self.healthy,
-                    "draining": self.draining, "status": self.status,
-                    "role": self.role,
-                    "model_hash": self.model_hash, "slots": self.slots,
-                    "free_slots": self.free_slots,
-                    "queue_depth": self.queue_depth,
-                    "inflight": self.inflight,
-                    "pid": self.pid, "uptime_s": self.uptime_s}
+            out = {"id": self.id, "healthy": self.healthy,
+                   "draining": self.draining,
+                   "status": ("degraded" if self.degraded and self.healthy
+                              and self.status == "ok" else self.status),
+                   "degraded": self.degraded,
+                   "role": self.role,
+                   "model_hash": self.model_hash, "slots": self.slots,
+                   "free_slots": self.free_slots,
+                   "queue_depth": self.queue_depth,
+                   "inflight": self.inflight,
+                   "pid": self.pid, "uptime_s": self.uptime_s,
+                   "cooldown_s": round(
+                       max(self.retry_after_until - time.monotonic(), 0.0),
+                       2)}
+        out.update(self.lat.snapshot_ms())
+        return out
+
+    # -- gray-failure state (fleet/latency.py detector) -----------------
+
+    def set_degraded(self, flag: bool) -> bool:
+        """Enter/exit probation atomically; returns True when the flag
+        actually changed (the caller counts transitions exactly once)."""
+        with self._lock:
+            if self.degraded == flag:
+                return False
+            self.degraded = flag
+            self.canary_ok = 0
+            return True
+
+    def canary_note(self, in_band: bool) -> int:
+        """Fold one canary outcome in; returns the consecutive in-band
+        streak (an out-of-band canary resets it)."""
+        with self._lock:
+            self.canary_ok = self.canary_ok + 1 if in_band else 0
+            return self.canary_ok
+
+    def note_retry_after(self, seconds: float, cap: float = 30.0) -> None:
+        """Honor the replica's own Retry-After: keep it out of pick() for
+        the window (capped — a pathological header must not eject a replica
+        for minutes). A clean idle poll clears the cooldown early
+        (apply_poll): the saturation the 503 reported has drained."""
+        until = time.monotonic() + min(max(seconds, 0.0), cap)
+        with self._lock:
+            self.retry_after_until = max(self.retry_after_until, until)
+
+    def in_cooldown(self) -> bool:
+        with self._lock:
+            return self.retry_after_until > time.monotonic()
 
     def mark_unreachable(self, clear_draining: bool = False) -> int:
         """Atomic ejection bookkeeping (poller failure path AND proxy-path
@@ -161,6 +220,13 @@ class Replica:
             if ok:
                 self.consecutive_failures = 0
                 self.last_ok = time.monotonic()
+                if (self.retry_after_until and self.queue_depth == 0
+                        and self.free_slots > 0):
+                    # the saturation the Retry-After reported has drained
+                    # (idle queue, free slots): end the cooldown early so a
+                    # recovered replica rejoins within one poll instead of
+                    # sitting out the full advisory window
+                    self.retry_after_until = 0.0
             return prev_uptime
 
 
@@ -187,6 +253,11 @@ class Membership:
         # lockstep; capped so a recovered replica rejoins within backoff_cap
         self.backoff_cap = backoff_cap
         self.down_log_interval = down_log_interval
+        # gray-failure detector (fleet/latency.py): evaluated once per poll
+        # round on this thread — probation ENTRY is poll-driven, EXIT is
+        # canary-outcome-driven on the proxy path. None = detection off;
+        # serve_router attaches its RouterState's detector before start().
+        self.detector = None
         self._backoff_rng = random.Random(0xD11A)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -227,9 +298,12 @@ class Membership:
             if not force and rep.next_poll_t > now:
                 continue  # unreachable replica inside its backoff window
             self._poll(rep)
+        if self.detector is not None:
+            self.detector.evaluate(self.replicas)
         _IN_ROTATION.set(len(self.in_rotation()))
 
     def _poll(self, rep: Replica) -> None:
+        t0 = time.perf_counter()
         try:
             faults.fire("router.health", replica=rep.id)
             conn = HTTPConnection(rep.host, rep.port,
@@ -240,6 +314,10 @@ class Membership:
                 body = json.loads(resp.read() or b"{}")
             finally:
                 conn.close()
+            # healthz round-trip: a latency signal that exists before any
+            # traffic flows — load_score tie-break + snapshot()/router
+            # /healthz visibility (docs/FLEET.md "Gray-failure resilience")
+            rep.lat.health_rtt.note(time.perf_counter() - t0)
         except Exception:
             rep.mark_unreachable(clear_draining=True)
             _POLLS.labels(outcome="unreachable").inc()
@@ -308,7 +386,22 @@ class Membership:
     # ------------------------------------------------------------------
 
     def in_rotation(self) -> list[Replica]:
-        return [r for r in self.replicas if r.healthy and not r.draining]
+        """Replicas eligible for NORMAL routing: healthy, not draining, not
+        in gray-failure probation, and outside any Retry-After cooldown
+        their own 503 asked for."""
+        now = time.monotonic()
+        return [r for r in self.replicas
+                if r.healthy and not r.draining and not r.degraded
+                and r.retry_after_until <= now]
+
+    def canary_candidates(self, exclude: set[str] = frozenset()
+                          ) -> list[Replica]:
+        """Degraded-but-alive replicas eligible for canary traffic (and for
+        the serving-beats-shedding fallback when normal rotation empties)."""
+        now = time.monotonic()
+        return [r for r in self.replicas
+                if r.healthy and not r.draining and r.degraded
+                and r.retry_after_until <= now and r.id not in exclude]
 
     def by_id(self, rep_id: str) -> Replica | None:
         for r in self.replicas:

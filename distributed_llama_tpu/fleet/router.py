@@ -49,6 +49,7 @@ from ..resilience.tenancy import (DrainRate, FairGate, TenantRegistry,
 from .affinity import AffinityMap
 from .disagg import DisaggPlanner
 from .journal import RequestJournal, iter_sse_data, parse_chunk
+from .latency import GrayConfig, GrayFailureDetector, LatencyStat, TokenBudget
 from .membership import Membership, Replica
 
 __all__ = ["RouterState", "serve_router", "close_router", "merge_prometheus",
@@ -93,6 +94,38 @@ _DRAIN_RATE = metrics.gauge(
     "router_drain_rate",
     "Measured fleet completions/sec through this router (decayed EMA) — "
     "the denominator of the router's drain-derived Retry-After hints")
+# Gray-failure resilience (docs/FLEET.md "Gray-failure resilience"):
+# outcome-driven TTFB tracking, bounded hedging, retry budgets, adaptive
+# timeouts, and Retry-After cooldowns.
+_TTFB = metrics.histogram(
+    "router_ttfb_seconds",
+    "Per-try time from issuing the upstream request to response headers "
+    "(api_server defers SSE headers to the first delta, so this is "
+    "first-byte time, replica queue wait included) — feeds the adaptive "
+    "pre-first-byte timeout and the hedge delay")
+_TTFB_TIMEOUT = metrics.gauge(
+    "router_ttfb_timeout_seconds",
+    "Current adaptive pre-first-byte timeout (mult x observed fleet TTFB "
+    "p95, clamped to the configured floor/cap; the cap until enough "
+    "samples exist)")
+_HEDGES = metrics.counter(
+    "router_hedges_total",
+    "Pre-first-byte request hedging by outcome: launched (duplicate try "
+    "issued after the hedge delay), won (the hedge delivered first byte "
+    "before the primary), denied (the hedge token budget was empty — "
+    "spend stays bounded under overload), canary (budget-exempt hedge of "
+    "a canary pick into a probation replica — its rate is bounded by "
+    "canary_every instead)", labelnames=("outcome",))
+_RETRY_DENIED = metrics.counter(
+    "router_retry_budget_denied_total",
+    "Failover retries suppressed because the global retry budget (token "
+    "bucket refilled by delivered completions) was exhausted — the "
+    "anti-retry-storm governor")
+_RETRY_AFTER_HONORED = metrics.counter(
+    "router_retry_after_honored_total",
+    "Replica 503 Retry-After hints honored as pick() cooldowns (the "
+    "failover loop no longer immediately re-hammers a replica that just "
+    "said it was saturated)")
 
 _KNOWN_ROUTES = ("/v1/chat/completions", "/chat/completions", "/v1/models",
                  "/v1/stats", "/metrics", "/health", "/healthz", "/v1/trace",
@@ -108,9 +141,22 @@ class RouterState:
                  journal_inflight: int = 4096,
                  tenants: TenantRegistry | None = None,
                  max_inflight: int = 0, gate_timeout: float = 30.0,
-                 disagg_threshold: int = 0, disagg_timeout: float = 60.0):
+                 disagg_threshold: int = 0, disagg_timeout: float = 60.0,
+                 gray: GrayConfig | None = None):
         assert policy in ("affinity", "random"), policy
         self.membership = membership
+        # gray-failure resilience (docs/FLEET.md "Gray-failure resilience"):
+        # outcome-driven fleet latency stats feed adaptive timeouts and the
+        # hedge delay; the detector runs probation; the budgets bound hedge
+        # and retry spend so failover can never amplify an overload
+        self.gray = gray or GrayConfig()
+        self.detector = GrayFailureDetector(self.gray)
+        self.fleet_ttfb = LatencyStat(window=256)
+        self.fleet_pace = LatencyStat(window=512)
+        self.hedge_budget = TokenBudget(self.gray.hedge_pct,
+                                        self.gray.hedge_burst)
+        self.retry_budget = TokenBudget(self.gray.retry_ratio,
+                                        self.gray.retry_cap)
         # prefill/decode disaggregation (docs/DISAGG.md): when the threshold
         # is armed, long-prompt completions run their prefill on a
         # prefill-capable replica, whose KV blocks the decode replica then
@@ -143,7 +189,8 @@ class RouterState:
         self.journal = RequestJournal(max_inflight=journal_inflight)
         self._rng = random.Random(seed)
         self._rr = 0  # round-robin clock for least-loaded ties
-        self._lock = threading.Lock()  # guards: _rng, _rr
+        self._canary_clock = 0  # every Nth pick canaries a degraded replica
+        self._lock = threading.Lock()  # guards: _rng, _rr, _canary_clock
 
     # ------------------------------------------------------------------
     # routing decision
@@ -179,6 +226,12 @@ class RouterState:
         rotation = [r for r in self.membership.in_rotation()
                     if r.id not in tried]
         if not rotation:
+            # serving beats shedding: with nothing healthy left, a
+            # probation replica (slow, not dead) still beats a 503 — this
+            # is also how the quorum-floor promise composes with failover
+            cands = self.membership.canary_candidates(tried)
+            if cands:
+                return min(cands, key=Replica.load_score), "canary"
             return None, "saturated"
         if prefer_roles is not None:
             preferred = [r for r in rotation if r.role in prefer_roles]
@@ -186,6 +239,17 @@ class RouterState:
                 rotation = preferred
         if tried:
             return min(rotation, key=Replica.load_score), "failover"
+        # canary trickle (docs/FLEET.md "Gray-failure resilience"): every
+        # canary_every-th first-try pick routes to a probation replica so
+        # rejoin evidence keeps flowing without it serving real share
+        cands = self.membership.canary_candidates(tried)
+        if cands:
+            with self._lock:
+                self._canary_clock += 1
+                take = (self._canary_clock
+                        % max(self.gray.canary_every, 1) == 0)
+            if take:
+                return min(cands, key=Replica.load_score), "canary"
         if self.policy == "random":
             with self._lock:
                 return self._rng.choice(rotation), "random"
@@ -207,9 +271,92 @@ class RouterState:
 
     def note_done(self) -> None:
         """One completion fully relayed: feed the drain estimator (the
-        denominator of every fleet-saturation Retry-After hint)."""
+        denominator of every fleet-saturation Retry-After hint) and refill
+        the global retry budget — successes are what entitle failover to
+        keep spending tries under stress."""
         self.drain.note()
         _DRAIN_RATE.set(self.drain.rate())
+        self.retry_budget.note()
+
+    # ------------------------------------------------------------------
+    # gray-failure signals: adaptive timeouts, hedge delay, budgets
+    # ------------------------------------------------------------------
+
+    def note_ttfb(self, rep: Replica, ttfb_s: float,
+                  ok: bool = True) -> None:
+        """Fold one upstream open's first-byte time into the replica's and
+        the fleet's stats. Only a SUCCESSFUL open (`ok`) is judged by the
+        detector for probation exit — a censored timeout sample records
+        "at least this slow", and when the effective TTFB timeout sits
+        below the ejection threshold that value would READ as in-band: a
+        degraded replica whose canaries never produced headers must reset
+        the rejoin streak, not extend it."""
+        rep.lat.ttfb.note(ttfb_s)
+        self.fleet_ttfb.note(ttfb_s)
+        _TTFB.observe(ttfb_s)
+        if ok:
+            self.detector.note_outcome(rep, ttfb_s,
+                                       self.membership.replicas)
+        elif rep.degraded:
+            rep.canary_note(False)  # a timed-out canary is still-bad evidence
+
+    def note_pace(self, rep: Replica, gap_s: float) -> None:
+        """One relayed stream event's inter-arrival gap (the idle-gap
+        timeout's evidence base)."""
+        rep.lat.pace.note(gap_s)
+        self.fleet_pace.note(gap_s)
+
+    def ttfb_timeout(self) -> float:
+        """Adaptive pre-first-byte timeout: mult x observed fleet TTFB p95,
+        clamped to [floor, cap]; the cap (the old fixed --proxy-timeout
+        behavior) until enough samples exist to trust the estimate."""
+        g = self.gray
+        cap = g.ttfb_cap if g.ttfb_cap is not None else self.try_timeout
+        if self.fleet_ttfb.count() < g.min_lat_samples:
+            _TTFB_TIMEOUT.set(cap)
+            return cap
+        p95 = self.fleet_ttfb.quantile(0.95) or cap
+        t = min(max(g.ttfb_mult * p95, g.ttfb_floor), cap)
+        _TTFB_TIMEOUT.set(t)
+        return t
+
+    def idle_timeout(self) -> float:
+        """Stream idle-gap timeout: how long one body read may block. Split
+        from the TTFB timeout so a healthy long generation (steady token
+        gaps) is distinguishable from a mid-stream wedge. Fixed when
+        configured, else mult x observed pace p99 clamped to
+        [idle_floor, --proxy-timeout]."""
+        g = self.gray
+        if g.idle_timeout > 0.0:
+            return g.idle_timeout
+        if self.fleet_pace.count() < g.min_lat_samples:
+            return self.try_timeout
+        p99 = self.fleet_pace.quantile(0.99) or self.try_timeout
+        return min(max(g.idle_mult * p99, g.idle_floor), self.try_timeout)
+
+    def hedge_delay(self) -> float | None:
+        """How long a pre-first-byte open may stay quiet before a duplicate
+        try is raced against it (a fixed --hedge-delay, else ~observed TTFB
+        p95); None = hedging off (disabled, or adaptive without enough
+        samples to place the delay)."""
+        g = self.gray
+        if not g.hedge:
+            return None
+        if g.hedge_delay > 0.0:
+            return g.hedge_delay
+        if self.fleet_ttfb.count() < g.min_lat_samples:
+            return None
+        p95 = self.fleet_ttfb.quantile(0.95)
+        return None if p95 is None else max(p95, g.hedge_floor)
+
+    def allow_retry(self) -> bool:
+        """Gate one failover retry on the global retry budget (refilled by
+        delivered completions): under a fleet-wide outage the budget drains
+        and the router stops amplifying load into a retry storm."""
+        if self.retry_budget.spend():
+            return True
+        _RETRY_DENIED.inc()
+        return False
 
     def retry_after_hint(self) -> float:
         """Drain-derived Retry-After for fleet-saturation refusals: the
@@ -340,6 +487,12 @@ def fleet_stats(state: RouterState) -> dict:
             "affinity_nodes": state.affinity.nodes(),
             "replicas": {r.id: r.snapshot()
                          for r in state.membership.replicas},
+            # gray-failure spend governors + current adaptive timeouts
+            # (docs/FLEET.md "Gray-failure resilience")
+            "gray": {"hedge_budget": state.hedge_budget.stats(),
+                     "retry_budget": state.retry_budget.stats(),
+                     "ttfb_timeout_s": round(state.ttfb_timeout(), 3),
+                     "idle_timeout_s": round(state.idle_timeout(), 3)},
             "metrics": metrics.snapshot(),
         },
         "replicas": {},
@@ -440,6 +593,11 @@ class RouterHandler(BaseHTTPRequestHandler):
                 "status": "ok" if rotation else "no_healthy_replicas",
                 "role": "router",
                 "in_rotation": len(rotation),
+                # gray-failure probation roster (docs/FLEET.md): degraded
+                # replicas are alive but canary-only — operators (and the
+                # chaos bench) watch entry/exit here
+                "degraded": [r.id for r in state.membership.replicas
+                             if r.degraded],
                 "replicas": {r.id: r.snapshot()
                              for r in state.membership.replicas},
             }
@@ -677,6 +835,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         tried: set[str] = set()
         last_503: tuple[bytes, str, str | None] | None = None
         for attempt in range(1 + state.retries):
+            if attempt and not state.allow_retry():
+                break  # retry budget drained: shed instead of storming
             extra = dict(tenant_hdrs) if tenant_hdrs else None
             if deadline_ms is not None:
                 # propagate the REMAINING budget, not the original: a retry
@@ -687,7 +847,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                     self._error(408, "client deadline expired during "
                                 "failover", "timeout_error")
                     return
-                extra = {"X-Deadline-Ms": str(int(rem) or 1)}
+                extra = dict(extra or {})
+                extra["X-Deadline-Ms"] = str(int(rem) or 1)
             rep, reason = state.pick(key, tried, prefer)
             if rep is None:
                 break
@@ -700,7 +861,10 @@ class RouterHandler(BaseHTTPRequestHandler):
                     trace.span("router.proxy",
                                {"replica": rep.id, "reason": reason,
                                 "attempt": attempt}):
-                outcome, info = self._proxy_try(rep, raw, key, hop, extra)
+                outcome, info = self._proxy_try(
+                    rep, raw, key, tried, hop, extra, prefer,
+                    canary=reason == "canary",
+                    stream=bool(body.get("stream")))
             if outcome == "delivered" or outcome == "aborted":
                 return
             if info is not None:  # a relayable 503 from this replica
@@ -760,6 +924,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                                    "client deadline expired during failover",
                                    "timeout_error")
                 return
+            if attempt and not state.allow_retry():
+                break  # retry budget drained: surface instead of storming
             rep, reason = state.pick(key, tried, prefer)
             if rep is None:
                 break
@@ -775,8 +941,10 @@ class RouterHandler(BaseHTTPRequestHandler):
                                {"replica": rep.id, "reason": reason,
                                 "attempt": attempt - 1, "durable": True,
                                 "resume_tokens": len(entry.tokens)}):
-                outcome, info = self._durable_try(rep, entry, key, hop,
-                                                  client_started)
+                outcome, info = self._durable_try(rep, entry, key, tried,
+                                                  hop, client_started,
+                                                  prefer,
+                                                  canary=reason == "canary")
             if outcome in ("done", "fatal"):
                 state.journal.close(
                     entry, entry.finish if outcome == "done" else "error")
@@ -786,9 +954,11 @@ class RouterHandler(BaseHTTPRequestHandler):
             if (len(entry.tokens), entry.sent_chars) != progress0:
                 # the replica served this request for a while before dying:
                 # new failover round — every OTHER replica is a candidate
-                # again (it may have rejoined rotation since)
+                # again (it may have rejoined rotation since). The replica
+                # to keep excluding is the one that actually SERVED the try
+                # (a hedge may have won the open away from `rep`).
                 fruitless = 1
-                tried = {rep.id}
+                tried = {entry.replicas[-1] if entry.replicas else rep.id}
             else:
                 fruitless += 1
         # candidates exhausted with no completion: surface honestly, with
@@ -809,9 +979,13 @@ class RouterHandler(BaseHTTPRequestHandler):
                         f"{len(state.membership.in_rotation())} in rotation)",
                         "overloaded_error", retry_after=retry_after)
 
-    def _durable_try(self, rep: Replica, entry, key: bytes, hop,
-                     client_started: list):
-        """One journaled upstream try. Returns (outcome, relayable_503):
+    def _durable_try(self, rep: Replica, entry, key: bytes, tried: set,
+                     hop, client_started: list, prefer=None,
+                     canary: bool = False):
+        """One journaled upstream try (hedged pre-first-byte via
+        `_open_raced` — the journal is only ever fed from the WINNING
+        response, on this handler thread, so a canceled hedge loser can
+        never fold tokens in). Returns (outcome, relayable_503):
         "done" — the completion reached the client (stream terminated or
         JSON sent); "fatal" — a deterministic error was relayed, do not
         retry; "retry" — the replica failed around the request (connect,
@@ -819,48 +993,43 @@ class RouterHandler(BaseHTTPRequestHandler):
         delivered stays journaled for the next candidate."""
         state = self.state
         mem = state.membership
-        mem.inflight_inc(rep)
-        _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
         if entry.tokens or entry.sent_chars:
             state.journal.note_resume(entry)
-        conn = None
+        headers = {"Content-Type": "application/json",
+                   "X-Dllama-Journal": "1",
+                   "traceparent": hop.to_traceparent()}
+        # tenant identity survives failover: every try (first AND
+        # resume) re-stamps the journaled tenant/class so the new
+        # replica's quota/fairness accounting stays attributed
+        if entry.tenant:
+            headers["X-Tenant"] = entry.tenant
+        if entry.klass:
+            headers["X-Class"] = entry.klass
+        rem = entry.remaining_deadline_ms()
+        if rem is not None:
+            headers["X-Deadline-Ms"] = str(max(int(rem), 1))
+        payload = json.dumps(entry.upstream_body()).encode()
         t0 = time.perf_counter()
+        # the durable upstream leg ALWAYS streams (X-Dllama-Journal: 1 —
+        # headers arrive at the first delta even for a non-stream client),
+        # so the adaptive pre-first-byte timeout applies unconditionally
+        win, conn, resp = self._open_raced(rep, payload, headers, key,
+                                           tried, prefer, canary=canary)
+        if win is None:
+            return "retry", None
         try:
-            try:
-                faults.fire("router.proxy", replica=rep.id)
-                headers = {"Content-Type": "application/json",
-                           "X-Dllama-Journal": "1",
-                           "traceparent": hop.to_traceparent()}
-                # tenant identity survives failover: every try (first AND
-                # resume) re-stamps the journaled tenant/class so the new
-                # replica's quota/fairness accounting stays attributed
-                if entry.tenant:
-                    headers["X-Tenant"] = entry.tenant
-                if entry.klass:
-                    headers["X-Class"] = entry.klass
-                rem = entry.remaining_deadline_ms()
-                if rem is not None:
-                    headers["X-Deadline-Ms"] = str(max(int(rem), 1))
-                conn = HTTPConnection(rep.host, rep.port,
-                                      timeout=state.try_timeout)
-                conn.request("POST", self.path,
-                             json.dumps(entry.upstream_body()).encode(),
-                             headers)
-                resp = conn.getresponse()
-            except Exception:
-                _PROXY_ERRORS.labels(kind="connect").inc()
-                mem.mark_failed(rep)
-                return "retry", None
-            entry.replicas.append(rep.id)
+            entry.replicas.append(win.id)
             if resp.status == 503:
                 data = resp.read()
                 _PROXY_ERRORS.labels(kind="status_503").inc()
                 if b"server_shutting_down" in data or b"draining" in data:
-                    rep.draining = True
+                    win.draining = True
+                ra = resp.getheader("Retry-After")
+                self._note_retry_after(win, ra)
                 return "retry", (data,
                                  resp.getheader("Content-Type",
                                                 "application/json"),
-                                 resp.getheader("Retry-After"))
+                                 ra)
             ctype = resp.getheader("Content-Type", "")
             if "text/event-stream" not in ctype:
                 # pre-stream deterministic error (400/408...): relay with
@@ -870,11 +1039,11 @@ class RouterHandler(BaseHTTPRequestHandler):
                     data = resp.read()
                 except Exception:
                     _PROXY_ERRORS.labels(kind="read").inc()
-                    mem.mark_failed(rep)
+                    mem.mark_failed(win)
                     return "retry", None
                 if client_started[0]:
                     self._sse_error_event(
-                        f"replica {rep.id} refused the resume with status "
+                        f"replica {win.id} refused the resume with status "
                         f"{resp.status}", "server_error")
                 else:
                     extra = {h: v for h in self._RELAY_HEADERS
@@ -882,16 +1051,15 @@ class RouterHandler(BaseHTTPRequestHandler):
                     self._raw(resp.status, ctype or "application/json",
                               data, extra or None)
                 return "fatal", None
-            outcome = self._durable_relay(rep, entry, resp, client_started,
+            outcome = self._durable_relay(win, entry, resp, client_started,
                                           key)
             if outcome == "done":
                 _PROXY_SECONDS.observe(time.perf_counter() - t0)
             return outcome, None
         finally:
-            if conn is not None:
-                conn.close()
-            mem.inflight_dec(rep)
-            _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+            conn.close()
+            mem.inflight_dec(win)
+            _INFLIGHT.labels(replica=win.id).set(win.inflight)
 
     def _durable_relay(self, rep: Replica, entry, resp,
                        client_started: list, key: bytes):
@@ -906,15 +1074,21 @@ class RouterHandler(BaseHTTPRequestHandler):
         up_chars = 0
         saw_done = False
         events = iter_sse_data(resp)
+        t_last = time.perf_counter()
         while True:
             try:
                 data = next(events)
             except StopIteration:
                 break
             except Exception:
+                # includes the idle-gap socket timeout: a wedged replica
+                # stops producing and the durable path resumes elsewhere
                 _PROXY_ERRORS.labels(kind="read").inc()
                 self.state.membership.mark_failed(rep)
                 return "retry"
+            now = time.perf_counter()
+            self.state.note_pace(rep, now - t_last)  # idle-gap evidence
+            t_last = now
             if data == "[DONE]":
                 saw_done = True
                 break
@@ -1038,54 +1212,278 @@ class RouterHandler(BaseHTTPRequestHandler):
     # other backoff-bearing statuses keep their hint through the proxy
     _RELAY_HEADERS = ("X-Request-Id", "X-Replica", "Retry-After")
 
-    def _proxy_try(self, rep: Replica, raw: bytes, key: bytes, hop=None,
-                   extra_headers: dict | None = None):
-        """One proxy attempt against `rep`. Returns (outcome, relayable):
-        outcome "delivered" (response fully relayed), "aborted" (failed
-        after client bytes — already terminated, never retry), or "retry"
-        (nothing reached the client; relayable = (body, ctype, retry_after)
-        when the failure was a replica 503 worth relaying). `hop` is this
-        try's trace context, stamped upstream as `traceparent`; the
-        replica's X-Request-Id/X-Replica response headers are relayed so
-        the client can reach GET /v1/requests/<id> on the serving replica.
+    def _open_raced(self, primary: Replica, payload: bytes, headers: dict,
+                    key: bytes, tried: set, prefer, canary: bool = False,
+                    stream: bool = True):
+        """Pre-first-byte phase of one failover round, with bounded hedging
+        (docs/FLEET.md "Gray-failure resilience"): open the upstream leg
+        (connect -> request -> response headers) against `primary` under the
+        ADAPTIVE pre-first-byte timeout; if no headers arrive within the
+        hedge delay (~fleet TTFB p95) and the hedge token budget allows,
+        race one duplicate open on a different replica — first headers win,
+        the loser is closed before any body byte of it is read. The
+        pre-first-byte phase is idempotent (PR 6/9 semantics), so the
+        duplicate can never double-deliver; the budget caps hedge spend so
+        hedging can never melt an overloaded fleet.
+
+        A 503 is a REFUSAL, not a first byte: while a rival attempt is
+        still in flight it is stashed as the round's fallback instead of
+        crowning it — a saturated hedge target must not cancel a primary
+        that is about to deliver. It is promoted to winner only when no
+        attempt produced a real response (the caller then relays/cools it
+        exactly as before).
+
+        Returns (winner, conn, resp) — winner None when every attempt
+        failed (each already mark_failed + counted). The winner's id and
+        every failed attempt's id are added to `tried` (handler thread
+        only); the winner's inflight count stays held for the caller's
+        relay (released in the caller's finally), losers release their own.
+        The winner's socket is switched to the idle-gap timeout before
+        return, so each body read may block at most idle_timeout(). When
+        no hedge can possibly arm this round (delay None), the open runs
+        INLINE on the handler thread — the common no-hedging path pays no
+        thread spawn or cv polling."""
+        state = self.state
+        mem = state.membership
+        # the ADAPTIVE pre-first-byte timeout is a STREAMING instrument:
+        # api_server defers stream headers to the first delta, so stream
+        # TTFB is genuinely first-byte time. A non-streaming response's
+        # first byte only arrives after the FULL generation — judging it
+        # by the fleet's (stream-dominated) TTFB p95 would kill every
+        # legitimately long non-stream completion, so those keep the cap
+        # (the pre-adaptive fixed behavior)
+        if stream:
+            ttfb_to = state.ttfb_timeout()
+        else:
+            g = state.gray
+            ttfb_to = (g.ttfb_cap if g.ttfb_cap is not None
+                       else state.try_timeout)
+        idle_to = state.idle_timeout()
+        state.hedge_budget.note()  # budget accrues per round, spent per hedge
+        cv = threading.Condition()
+        race = {"win": None, "soft": None, "lost": 0, "failed_ids": [],
+                "started": 1, "hedge_id": None}
+
+        def settled() -> bool:
+            # a stashed 503 settles the round only once no rival is left
+            return (race["win"] is not None
+                    or race["lost"] + (1 if race["soft"] else 0)
+                    >= race["started"])
+
+        def attempt(rep: Replica) -> None:
+            mem.inflight_inc(rep)
+            _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+            won = False
+            held = False
+            conn = None
+            t0 = time.perf_counter()
+            try:
+                faults.fire("router.proxy", replica=rep.id)
+                conn = HTTPConnection(rep.host, rep.port, timeout=ttfb_to)
+                conn.request("POST", self.path, payload, headers)
+                resp = conn.getresponse()
+            except Exception:
+                elapsed = time.perf_counter() - t0
+                if stream and elapsed >= 0.9 * ttfb_to:
+                    # timeout-shaped failure: record the CENSORED latency
+                    # (at least this slow) so a replica whose tries never
+                    # finish still accumulates outlier evidence for the
+                    # detector; connect refusals fail fast and are not
+                    # latency samples
+                    state.note_ttfb(rep, elapsed, ok=False)
+                _PROXY_ERRORS.labels(kind="connect").inc()
+                mem.mark_failed(rep)
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                with cv:
+                    race["lost"] += 1
+                    race["failed_ids"].append(rep.id)
+                    cv.notify_all()
+            else:
+                if resp.status >= 400:
+                    # an error/refusal is NOT a first byte: no TTFB
+                    # evidence (a fast 503/429 would mask a slow replica,
+                    # drag the adaptive timeout down during overload, and
+                    # walk a still-slow replica out of probation); a 503
+                    # canary resets the rejoin streak — saturated is not
+                    # recovered. Other errors say nothing about latency.
+                    if resp.status == 503 and rep.degraded:
+                        rep.canary_note(False)
+                elif stream:
+                    # only STREAM first-byte times are service-latency
+                    # evidence — a non-stream try's headers arrive after
+                    # the full generation and would read as an outlier
+                    state.note_ttfb(rep, time.perf_counter() - t0)
+                with cv:
+                    if race["win"] is None and resp.status < 400:
+                        race["win"] = (rep, conn, resp)
+                        won = True
+                        # switch to the idle-gap timeout INSIDE the critical
+                        # section: the handler thread cannot observe the win
+                        # (and start relaying / closing the conn) until cv
+                        # is released, so this can never race conn.close()
+                        if conn.sock is not None:
+                            conn.sock.settimeout(idle_to)
+                    elif race["win"] is None and race["soft"] is None:
+                        # an error while a rival may still deliver: stash,
+                        # do not crown (a refusing hedge target must not
+                        # cancel a viable primary with a 503/429 the
+                        # primary would never have issued); the handler
+                        # promotes or releases it
+                        race["soft"] = (rep, conn, resp)
+                        held = True
+                    else:
+                        race["lost"] += 1
+                        if resp.status == 503:
+                            # an uncrowned refusal still means "saturated":
+                            # cool the replica down and exclude it from
+                            # this round's remaining failover candidates
+                            race["failed_ids"].append(rep.id)
+                    cv.notify_all()
+                if not won and not held and resp.status == 503:
+                    self._note_retry_after(rep,
+                                           resp.getheader("Retry-After"))
+                if won:
+                    if rep.id == race["hedge_id"]:
+                        _HEDGES.labels(outcome="won").inc()
+                elif not held:
+                    try:  # lost the race; nothing of it was relayed
+                        conn.close()
+                    except Exception:
+                        pass
+            finally:
+                if not won and not held:
+                    mem.inflight_dec(rep)
+                    _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+
+        # hedging is also a STREAMING instrument here: the delay derives
+        # from stream first-byte times, and hedging a non-stream try whose
+        # generation simply outlasts that delay would systematically
+        # duplicate the longest generations (the always-streaming durable
+        # leg — the default path — still hedges non-stream CLIENTS)
+        delay = state.hedge_delay() if stream else None
+        if canary and state.gray.hedge and stream:
+            # a canary pick deliberately routes INTO a known-slow replica:
+            # hedge it almost immediately and OUTSIDE the budget (the
+            # canary rate is already bounded by canary_every), so probation
+            # probing never costs the client the victim's latency — the
+            # canary attempt still completes and records its outcome as
+            # the race loser
+            delay = max(state.gray.hedge_floor, 0.05)
+        if delay is None:
+            # hedging cannot arm this round: open inline, no thread spawn
+            attempt(primary)
+        else:
+            threading.Thread(target=attempt, args=(primary,), daemon=True,
+                             name="proxy-try").start()
+        with cv:
+            if delay is not None:
+                cv.wait_for(settled, timeout=delay)
+                if not settled():
+                    # primary quiet past the hedge delay: try to race it
+                    hedge, _ = state.pick(key, tried | {primary.id}, prefer)
+                    if hedge is not None and hedge.id != primary.id:
+                        if canary or state.hedge_budget.spend():
+                            race["hedge_id"] = hedge.id
+                            race["started"] += 1
+                            _HEDGES.labels(
+                                outcome="canary" if canary
+                                else "launched").inc()
+                            threading.Thread(target=attempt, args=(hedge,),
+                                             daemon=True,
+                                             name="proxy-hedge").start()
+                        else:
+                            _HEDGES.labels(outcome="denied").inc()
+            while not settled():
+                cv.wait(timeout=1.0)
+            win = race["win"]
+            soft = race["soft"]
+            failed_ids = list(race["failed_ids"])
+        if win is None and soft is not None:
+            win = soft  # every rival failed: the 503 is the round's answer
+        elif soft is not None:
+            # a real winner emerged; release the stashed error — but a
+            # 503 still means "saturated": honor the cooldown and exclude
+            # the replica from this round's remaining candidates
+            rep_s, conn_s, resp_s = soft
+            if resp_s.status == 503:
+                self._note_retry_after(rep_s,
+                                       resp_s.getheader("Retry-After"))
+                tried.add(rep_s.id)
+            try:
+                conn_s.close()
+            except Exception:
+                pass
+            mem.inflight_dec(rep_s)
+            _INFLIGHT.labels(replica=rep_s.id).set(rep_s.inflight)
+        for rid in failed_ids:
+            tried.add(rid)
+        if win is None:
+            return None, None, None
+        tried.add(win[0].id)
+        return win
+
+    def _note_retry_after(self, rep: Replica, ra_header) -> None:
+        """Honor a replica 503's Retry-After as a pick() cooldown: the
+        failover loop must not immediately re-hammer a replica that just
+        said it was saturated (absent/garbage headers read as 1 s)."""
+        try:
+            secs = float(ra_header) if ra_header else 1.0
+        except (TypeError, ValueError):
+            secs = 1.0
+        rep.note_retry_after(secs)
+        _RETRY_AFTER_HONORED.inc()
+
+    def _proxy_try(self, rep: Replica, raw: bytes, key: bytes, tried: set,
+                   hop=None, extra_headers: dict | None = None, prefer=None,
+                   canary: bool = False, stream: bool = True):
+        """One proxy attempt against `rep` (plus, past the hedge delay, a
+        budget-bounded duplicate on another replica — `_open_raced`).
+        Returns (outcome, relayable): outcome "delivered" (response fully
+        relayed), "aborted" (failed after client bytes — already
+        terminated, never retry), or "retry" (nothing reached the client;
+        relayable = (body, ctype, retry_after) when the failure was a
+        replica 503 worth relaying). `hop` is this try's trace context,
+        stamped upstream as `traceparent`; the replica's
+        X-Request-Id/X-Replica response headers are relayed so the client
+        can reach GET /v1/requests/<id> on the serving replica.
         `extra_headers` carries per-try headers (remaining X-Deadline-Ms)."""
         state = self.state
         mem = state.membership
-        mem.inflight_inc(rep)
-        _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
-        conn = None
+        headers = {"Content-Type": "application/json"}
+        if extra_headers:
+            headers.update(extra_headers)
+        if hop is not None:
+            headers["traceparent"] = hop.to_traceparent()
         t0 = time.perf_counter()
+        win, conn, resp = self._open_raced(rep, raw, headers, key, tried,
+                                           prefer, canary=canary,
+                                           stream=stream)
+        if win is None:
+            return "retry", None
         try:
-            try:
-                faults.fire("router.proxy", replica=rep.id)
-                headers = {"Content-Type": "application/json"}
-                if extra_headers:
-                    headers.update(extra_headers)
-                if hop is not None:
-                    headers["traceparent"] = hop.to_traceparent()
-                conn = HTTPConnection(rep.host, rep.port,
-                                      timeout=state.try_timeout)
-                conn.request("POST", self.path, raw, headers)
-                resp = conn.getresponse()
-            except Exception:
-                _PROXY_ERRORS.labels(kind="connect").inc()
-                mem.mark_failed(rep)
-                return "retry", None
             if resp.status == 503:
                 # shed (overloaded, Retry-After) or drain — in both cases
                 # another replica may serve this request right now. Reflect
                 # a drain in membership immediately; the poller confirms.
+                # Either way honor the Retry-After as a pick() cooldown so
+                # failover doesn't re-hammer the saturated replica.
                 data = resp.read()
                 _PROXY_ERRORS.labels(kind="status_503").inc()
                 if b"server_shutting_down" in data or b"draining" in data:
-                    rep.draining = True
+                    win.draining = True
+                ra = resp.getheader("Retry-After")
+                self._note_retry_after(win, ra)
                 return "retry", (data,
                                  resp.getheader("Content-Type",
                                                 "application/json"),
-                                 resp.getheader("Retry-After"))
+                                 ra)
             ctype = resp.getheader("Content-Type", "application/json")
             if "text/event-stream" in ctype:
-                return self._relay_stream(rep, resp, key)
+                return self._relay_stream(win, resp, key)
             # non-streaming (includes pre-stream errors with real status
             # codes — api_server defers SSE headers to the first delta, so a
             # 400/408 arrives here as plain JSON): relay verbatim, no retry
@@ -1096,24 +1494,23 @@ class RouterHandler(BaseHTTPRequestHandler):
                 data = resp.read()
             except Exception:
                 _PROXY_ERRORS.labels(kind="read").inc()
-                mem.mark_failed(rep)
+                mem.mark_failed(win)
                 return "retry", None
             extra = {h: v for h in self._RELAY_HEADERS
                      if (v := resp.getheader(h))}
             if resp.status == 200:
                 # record BEFORE relaying: the client must not observe the
                 # completion while the route is still unrecorded
-                state.affinity.record(key, rep.id)
+                state.affinity.record(key, win.id)
             self._raw(resp.status, ctype, data, extra or None)
             if resp.status == 200:
                 _PROXY_SECONDS.observe(time.perf_counter() - t0)
                 state.note_done()  # feeds the drain-derived Retry-After
             return "delivered", None
         finally:
-            if conn is not None:
-                conn.close()
-            mem.inflight_dec(rep)
-            _INFLIGHT.labels(replica=rep.id).set(rep.inflight)
+            conn.close()
+            mem.inflight_dec(win)
+            _INFLIGHT.labels(replica=win.id).set(win.inflight)
 
     def _relay_stream(self, rep: Replica, resp, key: bytes):
         """SSE pass-through. Client headers are deferred to the first
@@ -1122,10 +1519,17 @@ class RouterHandler(BaseHTTPRequestHandler):
         state = self.state
         sent_any = False
         t0 = time.perf_counter()
+        t_last = t0
         while True:
             try:
                 chunk = resp.read1(65536)
+                now = time.perf_counter()
+                state.note_pace(rep, now - t_last)  # idle-gap evidence
+                t_last = now
             except Exception:
+                # includes the idle-gap socket timeout (a mid-stream wedge);
+                # without durable routing the only honest move after bytes
+                # flowed is the SSE error below
                 _PROXY_ERRORS.labels(kind="read").inc()
                 if not sent_any:
                     state.membership.mark_failed(rep)
@@ -1183,7 +1587,8 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
                  max_inflight: int = 0,
                  gate_timeout: float = 30.0,
                  disagg_threshold: int = 0,
-                 disagg_timeout: float = 60.0) -> ThreadingHTTPServer:
+                 disagg_timeout: float = 60.0,
+                 gray: GrayConfig | None = None) -> ThreadingHTTPServer:
     """Build + bind the router (does NOT serve_forever — caller's thread
     choice). Membership is polled once synchronously so the first request
     already has a rotation. `server.router_state` exposes the state.
@@ -1191,7 +1596,9 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
     (mid-stream failures surfaced, not resumed). `tenants` (a registry or
     the parseable spec string) enables router-level quotas; `max_inflight`
     > 0 arms the weighted-fair inflight gate (docs/SERVING.md
-    "Multi-tenant serving")."""
+    "Multi-tenant serving"). `gray` tunes the gray-failure resilience layer
+    (probation, hedging, adaptive timeouts, retry budget — docs/FLEET.md
+    "Gray-failure resilience"); None = GrayConfig() defaults."""
     if isinstance(tenants, str):
         tenants = TenantRegistry.parse(tenants) if tenants else None
     membership = Membership(replicas, poll_interval=poll_interval,
@@ -1202,7 +1609,10 @@ def serve_router(replicas: list[str], host: str = "0.0.0.0",
                         tenants=tenants, max_inflight=max_inflight,
                         gate_timeout=gate_timeout,
                         disagg_threshold=disagg_threshold,
-                        disagg_timeout=disagg_timeout)
+                        disagg_timeout=disagg_timeout, gray=gray)
+    # probation entry runs on the poll thread; the detector must be attached
+    # BEFORE the synchronous first poll inside start()
+    membership.detector = state.detector
     membership.start()
     handler = type("BoundRouterHandler", (RouterHandler,),
                    {"state": state, "protocol_version": "HTTP/1.1"})

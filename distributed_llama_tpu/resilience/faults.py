@@ -21,7 +21,7 @@ named, seedable events:
   `faults.install(...)` (process-wide), or the `DLLAMA_FAULTS` env var parsed
   by `install_from_env()` (wired into the dllama / api_server entry points):
 
-      DLLAMA_FAULTS="point:kind[:prob[:count[:delay_ms]]][,spec2,...]"
+      DLLAMA_FAULTS="point:kind[:prob[:count[:delay_ms[:duration_s]]]][,...]"
       DLLAMA_FAULT_SEED=7
 
   e.g. `DLLAMA_FAULTS="batch.dispatch:transient:0.01"` injects a 1% transient
@@ -72,8 +72,15 @@ class FaultSpec:
     delay_ms: float = 25.0     # latency kind: injected stall
     scope: str = "request"     # error kind: request | engine
     match: dict = field(default_factory=dict)
+    # sustained-degradation window (gray failures, docs/ROBUSTNESS.md
+    # "Gray failures"): the spec stops firing `duration_s` seconds after
+    # its FIRST fire — "this replica is 10x slow for two minutes, then
+    # recovers", the shape probation entry/exit detection needs. None =
+    # no window (the per-call behavior all older specs keep).
+    duration_s: float | None = None
     seen: int = 0              # matching hits observed (runtime state)
     fired: int = 0             # faults actually injected (runtime state)
+    first_fire_t: float = 0.0  # monotonic of the first fire (runtime state)
 
     def __post_init__(self):
         assert self.kind in KINDS, f"unknown fault kind {self.kind!r}"
@@ -105,9 +112,15 @@ class FaultPlan:
                     continue
                 if spec.count is not None and spec.fired >= spec.count:
                     continue
+                if (spec.duration_s is not None and spec.first_fire_t
+                        and time.monotonic() - spec.first_fire_t
+                        > spec.duration_s):
+                    continue  # sustained-degradation window expired
                 if spec.prob < 1.0 and self._rng.random() >= spec.prob:
                     continue
                 spec.fired += 1
+                if not spec.first_fire_t:
+                    spec.first_fire_t = time.monotonic()
             _INJECTED.labels(point=point, kind=spec.kind).inc()
             # flight-recorder timeline hook: when the injection point fires
             # inside a request's bound trace context (per-request points:
@@ -170,20 +183,26 @@ def active(*specs, seed: int = 0):
 def parse_faults(text: str) -> list[FaultSpec]:
     """Parse the DLLAMA_FAULTS grammar:
 
-        spec[,spec...]   spec = point:kind[:prob[:count[:delay_ms]]]
+        spec[,spec...]
+        spec = point:kind[:prob[:count[:delay_ms[:duration_s]]]]
 
-    `count` may be empty or "inf" for unlimited. Raises ValueError with the
-    offending spec on malformed input (a typo'd chaos config must fail loud,
-    not silently inject nothing)."""
+    `count` may be empty or "inf" for unlimited; `duration_s` (empty = none)
+    arms the sustained-degradation window — the spec stops firing that many
+    seconds after its first fire, e.g.
+    `api.request:latency:1::800:45` = every request 800 ms slow for 45 s
+    from the first hit, then recovered (the gray-failure chaos shape).
+    Raises ValueError with the offending spec on malformed input (a typo'd
+    chaos config must fail loud, not silently inject nothing)."""
     specs = []
     for raw in text.split(","):
         raw = raw.strip()
         if not raw:
             continue
         parts = raw.split(":")
-        if len(parts) < 2 or len(parts) > 5:
-            raise ValueError(f"bad fault spec {raw!r} "
-                             "(point:kind[:prob[:count[:delay_ms]]])")
+        if len(parts) < 2 or len(parts) > 6:
+            raise ValueError(
+                f"bad fault spec {raw!r} "
+                "(point:kind[:prob[:count[:delay_ms[:duration_s]]]])")
         point, kind = parts[0], parts[1]
         if kind not in KINDS:
             raise ValueError(f"bad fault kind {kind!r} in {raw!r} "
@@ -193,10 +212,12 @@ def parse_faults(text: str) -> list[FaultSpec]:
             count = (None if len(parts) <= 3 or parts[3] in ("", "inf")
                      else int(parts[3]))
             delay = float(parts[4]) if len(parts) > 4 and parts[4] else 25.0
+            duration = (float(parts[5]) if len(parts) > 5 and parts[5]
+                        else None)
         except ValueError:
             raise ValueError(f"bad numeric field in fault spec {raw!r}")
         specs.append(FaultSpec(point=point, kind=kind, prob=prob, count=count,
-                               delay_ms=delay))
+                               delay_ms=delay, duration_s=duration))
     return specs
 
 

@@ -101,6 +101,20 @@ DISAGG_CELLS = 2 * len(DISAGG_POINTS) * 2 + len(DISAGG_PLAN_POINTS)
 FAIRNESS_SCENARIOS = ("none", "chaos-transient", "chaos-error", "failover")
 FAIRNESS_CELLS = len(FAIRNESS_SCENARIOS) * 2  # × {pipelined, serialized}
 
+# Gray-failure family (ISSUE 14, docs/FLEET.md "Gray-failure resilience"):
+# one replica of a REAL two-replica fleet under a SUSTAINED latency
+# injection (api.request latency matched to the victim — it keeps answering
+# healthz ok while serving slow, the gray shape) across resilience modes ×
+# {stream, nonstream}. Modes: "route" = outlier detection + probation only,
+# "timeout" = + adaptive pre-first-byte timeout (tries to the victim are
+# cut and failed over), "hedge" = + budget-bounded duplicate tries. Every
+# cell asserts 0 client-visible failures with byte-identical output
+# (greedy AND pinned-seed), the victim observed ENTERING probation while
+# slow and REJOINING after the injection clears (canary-driven), rotation
+# recovered, and — hedge mode — hedge spend within the configured budget.
+GRAY_MODES = ("route", "timeout", "hedge")
+GRAY_CELLS = len(GRAY_MODES) * 2  # × {stream, nonstream}
+
 
 def _spec(seq_len=128):
     return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
@@ -611,10 +625,11 @@ def _fleet_model_files():
     return _FLEET_MODEL
 
 
-def build_durable_fleet(speculative: int = 0):
+def build_durable_fleet(speculative: int = 0, router_kwargs: dict = None):
     """Two REAL in-process api_server replicas (tiny checkpoint, batched
     engines) fronted by the REAL durable router. Returns
-    (replicas=[(engine, server, port)], router, rport, close)."""
+    (replicas=[(engine, server, port)], router, rport, close).
+    `router_kwargs` extends serve_router (the gray family's GrayConfig)."""
     import threading
 
     from distributed_llama_tpu.apps.api_server import serve
@@ -636,7 +651,8 @@ def build_durable_fleet(speculative: int = 0):
         reps.append((be, srv, srv.server_address[1]))
     router = serve_router([f"127.0.0.1:{p}" for _, _, p in reps],
                           host="127.0.0.1", port=0, poll_interval=0.15,
-                          block_bytes=16, retries=2, try_timeout=60.0)
+                          block_bytes=16, retries=2, try_timeout=60.0,
+                          **(router_kwargs or {}))
     threading.Thread(target=router.serve_forever, daemon=True).start()
 
     def close():
@@ -1050,6 +1066,212 @@ def run_disagg_family() -> tuple[int, list[str]]:
     return cells, problems
 
 
+# ----------------------------------------------------------------------
+# gray-failure family: sustained-slow replica, probation, hedging
+# ----------------------------------------------------------------------
+
+def _gray_request(rport: int, stream: bool, seed=None, salt: str = "",
+                  scatter: str = "") -> dict:
+    """One short completion through the router; {text, error, status}.
+    `scatter` (when set) replaces the shared system prompt with a UNIQUE
+    one: affinity would otherwise pin every request to one replica and the
+    victim would never see the traffic detection needs — a cold prefix
+    falls back to least-loaded with round-robin ties, alternating replicas.
+    The unique part must LEAD the prompt (the affinity key is block-
+    granular: a shared 16-byte prefix block still pins). Scattered requests
+    are liveness probes only (their text depends on the prompt, so identity
+    is asserted on the fixed-prompt requests)."""
+    import http.client
+    import json as _json
+
+    body = {"messages": [
+        {"role": "system", "content": scatter or "gray fleet system prompt"},
+        {"role": "user", "content": f"ab ab {salt}"}],
+        "max_tokens": 6, "temperature": 0, "stream": stream}
+    if seed is not None:
+        body.update(temperature=0.9, seed=seed)
+    conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
+    try:
+        conn.request("POST", "/v1/chat/completions", _json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if not stream:
+            data = _json.loads(resp.read() or b"{}")
+            if resp.status != 200:
+                return {"text": None, "error": data, "status": resp.status}
+            return {"text": data["choices"][0]["message"]["content"],
+                    "error": None, "status": 200}
+        if resp.status != 200:
+            return {"text": None, "error": resp.read().decode(),
+                    "status": resp.status}
+        text, err = [], None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            payload = _json.loads(line[6:])
+            if "error" in payload:
+                err = payload["error"]
+                break
+            d = payload["choices"][0]["delta"].get("content")
+            if d:
+                text.append(d)
+        return {"text": "".join(text), "error": err, "status": 200}
+    except Exception as e:
+        return {"text": None, "error": repr(e), "status": None}
+    finally:
+        conn.close()
+
+
+def run_gray_mode(state, reps, rport: int, victim, mode: str,
+                  refs: dict) -> list[str]:
+    """One gray-failure mode over the shared fleet: configure the
+    resilience layer for `mode`, sustain-slow the victim, and assert the
+    family's invariants (module docstring at GRAY_MODES)."""
+    from distributed_llama_tpu.fleet.latency import TokenBudget
+    from distributed_llama_tpu.obs import metrics as obs_metrics
+
+    problems: list[str] = []
+    name = f"gray/{mode}"
+    g = state.gray
+    # mode wiring (fields mutated in place — the detector and membership
+    # hold the same GrayConfig object)
+    g.hedge = mode == "hedge"
+    if mode == "timeout":
+        # adaptive pre-first-byte timeout armed TIGHT: tries to the victim
+        # are cut (censored-sample recorded) and failed over
+        g.min_lat_samples = 8
+        g.ttfb_floor, g.ttfb_cap, g.ttfb_mult = 0.2, None, 2.0
+        delay_ms = 1200.0
+    elif mode == "hedge":
+        # fixed timeout (floor == cap) isolates hedging as the mechanism;
+        # fixed hedge delay — with one of two replicas slow, HALF the
+        # samples are slow and an adaptive p95 delay would defer itself
+        g.min_lat_samples = 8
+        g.ttfb_floor = g.ttfb_cap = 60.0
+        g.hedge_delay = 0.2
+        g.hedge_pct = 0.25
+        state.hedge_budget = TokenBudget(g.hedge_pct, g.hedge_burst)
+        delay_ms = 600.0
+    else:  # "route": detection + probation only, timeouts/hedging at caps
+        g.min_lat_samples = 10 ** 9
+        g.ttfb_floor, g.ttfb_cap = 5.0, None
+        delay_ms = 500.0
+    # hedge-spend baseline from the LAUNCH-SITE counter, not the budget's
+    # own ledger (gating stats()["spent"] against cap + rate*noted would be
+    # tautological — TokenBudget enforces that internally by construction;
+    # a regression that launches without spending must still fail the gate)
+    h0 = (obs_metrics.snapshot().get("router_hedges_total") or {}).get(
+        '{outcome="launched"}', 0)
+    i = 0
+    with faults.active(FaultSpec("api.request", kind="latency",
+                                 delay_ms=delay_ms,
+                                 match={"replica": victim.id})):
+        # identity drive: fixed prompt, stream x {greedy, pinned-seed} —
+        # every response client-clean and byte-identical to the reference
+        for stream in (True, False):
+            for seed in (None, 777):
+                res = _gray_request(rport, stream, seed)
+                tag = (f"{name}/{'stream' if stream else 'nonstream'}"
+                       f"/seed={seed}")
+                if res["error"] is not None or res["status"] != 200:
+                    problems.append(f"{tag}: client-visible failure {res!r}")
+                elif res["text"] != refs[(stream, seed)]:
+                    problems.append(f"{tag}: diverged ({res['text']!r:.40} "
+                                    f"vs {refs[(stream, seed)]!r:.40})")
+        # probation entry: scattered probes keep outcome samples flowing to
+        # BOTH replicas until the detector flags the victim. The budget is
+        # generous: hedged rounds leave the victim's (losing) attempts
+        # holding inflight counts, so least-loaded picks it only when idle
+        # — its sampling rate is a fraction of the probe rate.
+        deadline = time.monotonic() + 60
+        while not victim.degraded and time.monotonic() < deadline:
+            res = _gray_request(rport, i % 2 == 0, salt=str(i),
+                                scatter=f"p{i:04d} {name} probe")
+            if res["error"] is not None or res["status"] != 200:
+                problems.append(f"{name}: probe failure {res!r}")
+                break
+            i += 1
+            state.membership.poll_once()
+        if not victim.degraded:
+            problems.append(f"{name}: victim never entered probation "
+                            f"({victim.snapshot()})")
+    faults.uninstall()
+    # probation exit: the injection cleared — canary traffic must rejoin
+    # the victim within probation_exits in-band outcomes
+    deadline = time.monotonic() + 30
+    while victim.degraded and time.monotonic() < deadline:
+        res = _gray_request(rport, i % 2 == 0, salt=str(i),
+                            scatter=f"c{i:04d} {name} canary")
+        if res["error"] is not None or res["status"] != 200:
+            problems.append(f"{name}: canary failure {res!r}")
+            break
+        i += 1
+        state.membership.poll_once()
+    if victim.degraded:
+        problems.append(f"{name}: victim never rejoined after the "
+                        "injection cleared")
+    state.membership.poll_once()
+    if len(state.membership.in_rotation()) != len(reps):
+        problems.append(f"{name}: rotation did not recover")
+    if mode == "hedge":
+        st = state.hedge_budget.stats()
+        launched = (obs_metrics.snapshot().get("router_hedges_total")
+                    or {}).get('{outcome="launched"}', 0) - h0
+        allowance = st["cap"] + g.hedge_pct * st["noted"]
+        if launched < 1:
+            problems.append(f"{name}: vacuous — no hedge launched")
+        if launched > allowance:
+            problems.append(f"{name}: hedge spend {launched} over budget "
+                            f"(allowance {allowance:.1f})")
+    # no router-side inflight leak (hedge losers must release their counts)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        leaked = [r.id for r in state.membership.replicas if r.inflight != 0]
+        if not leaked:
+            break
+        time.sleep(0.02)
+    else:
+        problems.append(f"{name}: router inflight leak on {leaked}")
+    return problems
+
+
+def run_gray_family() -> tuple[int, list[str]]:
+    from distributed_llama_tpu.fleet.latency import GrayConfig
+
+    cells = 0
+    problems: list[str] = []
+    cfg = GrayConfig(eject_multiple=3.0, min_samples=4, probation_exits=2,
+                     quorum_frac=0.5, canary_every=2,
+                     min_lat_samples=10 ** 9, hedge=False)
+    reps, router, rport, close = build_durable_fleet(
+        router_kwargs={"gray": cfg})
+    state = router.router_state
+    victim = state.membership.by_id(f"127.0.0.1:{reps[0][2]}")
+    try:
+        refs = {}
+        for stream in (True, False):
+            for seed in (None, 777):
+                r = _gray_request(rport, stream, seed)
+                if r["error"] is not None:
+                    problems.append(
+                        f"gray: fault-free reference failed: {r!r}")
+                    return GRAY_CELLS, problems
+                refs[(stream, seed)] = r["text"]
+        if refs[(True, None)] != refs[(False, None)]:
+            problems.append("gray: stream vs non-stream reference mismatch")
+        for mode in GRAY_MODES:
+            cells += 2  # the mode drives stream AND nonstream cells
+            problems += run_gray_mode(state, reps, rport, victim, mode, refs)
+    finally:
+        faults.uninstall()
+        close()
+    return cells, problems
+
+
 def run_matrix(include_paged: bool = True,
                kinds=KINDS) -> tuple[int, list[str]]:
     cells = 0
@@ -1126,6 +1348,11 @@ def run_matrix(include_paged: bool = True,
     g_cells, g_problems = run_disagg_family()
     cells += g_cells
     problems += g_problems
+    # gray failures: sustained-slow replica -> probation + adaptive
+    # timeouts + bounded hedging (ISSUE 14, docs/FLEET.md)
+    y_cells, y_problems = run_gray_family()
+    cells += y_cells
+    problems += y_problems
     return cells, problems
 
 

@@ -25,7 +25,8 @@ def test_fault_matrix_no_scheduler_death_or_slot_leak():
                 + len(fault_matrix.PAGED_POINTS)
                 + len(fault_matrix.ROUTER_POINTS)) * len(fault_matrix.KINDS) \
         + fault_matrix.SUPERVISOR_CELLS + fault_matrix.DURABILITY_CELLS \
-        + fault_matrix.FAIRNESS_CELLS + fault_matrix.DISAGG_CELLS
+        + fault_matrix.FAIRNESS_CELLS + fault_matrix.DISAGG_CELLS \
+        + fault_matrix.GRAY_CELLS
     assert cells == expected, (cells, expected)
     assert not problems, "\n".join(problems)
 

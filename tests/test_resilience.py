@@ -102,8 +102,13 @@ def test_parse_faults_grammar():
     assert specs[0].kind == "transient" and specs[0].prob == 0.01
     assert specs[1].prob == 1.0 and specs[1].count is None
     assert specs[2].count == 3 and specs[2].delay_ms == 50.0
+    # sixth field = duration_s (the sustained-degradation window)
+    sustained = faults.parse_faults("p:latency:1::800:45")[0]
+    assert sustained.delay_ms == 800.0 and sustained.duration_s == 45.0
+    assert faults.parse_faults("p:error:1:2:3:4")[0].duration_s == 4.0
+    assert faults.parse_faults("p:error:1:2:3")[0].duration_s is None
     for bad in ("point-only", "p:unknownkind", "p:error:notaprob",
-                "p:error:1:2:3:4"):
+                "p:error:1:2:3:notasecs", "p:error:1:2:3:4:5"):
         with pytest.raises(ValueError):
             faults.parse_faults(bad)
 

@@ -40,7 +40,8 @@ def test_scan_covers_fleet_package():
     the compile + dead-import scan."""
     files = smoke_lint.repo_py_files()
     rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
-    for mod in ("router", "membership", "affinity", "disagg", "__init__"):
+    for mod in ("router", "membership", "affinity", "disagg", "latency",
+                "__init__"):
         assert os.path.join("distributed_llama_tpu", "fleet",
                             f"{mod}.py") in rel, mod
     assert os.path.join("distributed_llama_tpu", "apps", "router.py") in rel
