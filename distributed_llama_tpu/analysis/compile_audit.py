@@ -135,6 +135,18 @@ class CompileAudit:
             device_loop, "make_batched_verify_loop",
             lambda spec, mesh, params, t, **kw:
                 f"verify[t={t},{_static(kw)}]")
+        # model drafter programs (draft/, docs/SERVING.md "Model-based
+        # drafting") — patched at the DRAFTER's namespace (its module-global
+        # names bound at import, like engine.make_sharded_forward above)
+        from ..draft import drafter as draft_drafter
+
+        self._patch_factory(
+            draft_drafter, "make_draft_loop",
+            lambda spec, mesh, params, s, **kw: f"draft_scan[s={s}]")
+        self._patch_factory(
+            draft_drafter, "make_draft_step",
+            lambda spec, mesh, params, **kw:
+                f"draft_step[window={kw.get('attn_window')}]")
         return self
 
     def __exit__(self, *exc) -> None:
@@ -246,6 +258,35 @@ def run_scenario(keep_engine: bool = False):
                 encode_blocks(blocks)))
             ri = eng.submit(list(p3), 4, Sampler(V))
             ri.wait(60)
+        # phase 7 — model-based drafting (docs/SERVING.md "Model-based
+        # drafting"): a SECOND engine, identical config plus a co-resident
+        # drafter sharing the target's params (self-draft: full acceptance,
+        # so the drafter's scan cadence — and thus the pinned draft_scan
+        # bucket set — is deterministic). Target-side programs ride the
+        # same keys/signatures the first engine pinned; the drafter adds
+        # ONLY draft_scan[s=...] buckets. Adaptive-k runs live here — its
+        # buckets must never mint a verify program outside the pinned
+        # t=2/3/5 set (the "zero recompile creep under adaptive-k bucket
+        # churn" acceptance gate).
+        eng2 = BatchEngine(spec, params, slots=2, superstep=4, pipeline=True,
+                           speculative=4, spec_min_draft=1, tp=1,
+                           prefix_cache=True,
+                           draft_model=(spec, params))
+        try:
+            rd = eng2.submit([(7 * i + 3) % V for i in range(9)], 12,
+                             Sampler(V))
+            rd.wait(60)
+            rd2 = eng2.submit([(5 * i + 1) % V for i in range(6)], 8,
+                              Sampler(V))
+            rd2.wait(60)
+            # long prompt: attach-time pending exceeds the in-scan catch-up
+            # cap, so the drafter's chunked prefill program (draft_step)
+            # pins alongside the scan buckets
+            rd3 = eng2.submit([(3 * i + 2) % V for i in range(20)], 6,
+                              Sampler(V))
+            rd3.wait(60)
+        finally:
+            eng2.close()
         ok = True
     finally:
         # a failed phase must not leak a live engine (scheduler thread +

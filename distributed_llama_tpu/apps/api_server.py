@@ -303,18 +303,12 @@ def _stats_payload(state: "ApiState") -> dict:
             out["batch_engine"]["paged_kv"] = dict(
                 be.kv_pool.stats(), seed_bytes=be.seed_bytes,
                 seed_ms=round(be.seed_ms, 3))
-        if be.spec_k:
-            snap = metrics.snapshot()
-            drafted = snap.get("batch_spec_drafted_tokens_total", 0)
-            out["speculative"] = {
-                "k": be.spec_k,
-                "verify_steps": be.verify_steps,
-                "drafted_tokens": drafted,
-                "accepted_tokens": snap.get(
-                    "batch_spec_accepted_tokens_total", 0),
-                "accept_rate": (snap.get("batch_spec_accepted_tokens_total",
-                                         0) / drafted if drafted else None),
-            }
+        spec_block = be.spec_stats()
+        if spec_block is not None:
+            # engine accept counters + proposer (model drafter health /
+            # degradation) + per-row adaptive-k breakdown
+            # (docs/SERVING.md "Model-based drafting")
+            out["speculative"] = spec_block
     elif state.engine is not None:
         eng = state.engine
         out["engine"] = {"pos": eng.pos, "tp": eng.tp, "sp": eng.sp,
@@ -1425,7 +1419,12 @@ def main(argv=None) -> None:
             weights_ftype=_FT[args.weights_float_type] if args.weights_float_type
             else None,
             slots=args.batch, superstep=max(args.superstep, 1),
-            pipeline=args.pipeline, speculative=args.speculative,
+            pipeline=args.pipeline,
+            # --draft-model without --speculative K engages the default
+            # verify width (the drafter is useless without the verify path)
+            speculative=(args.speculative
+                         or (args.draft_k or 8 if args.draft_model else 0)),
+            draft_model=args.draft_model, draft_k=args.draft_k,
             prefix_cache=not args.no_prefix_cache,
             prefix_cache_blocks=args.prefix_cache_blocks,
             prefix_block_tokens=args.prefix_cache_block_tokens,
@@ -1451,10 +1450,18 @@ def main(argv=None) -> None:
               f"super-step K={batch_engine.superstep}, pipelined decode "
               f"{'on' if batch_engine.pipeline else 'off'}"
               + (f", speculative k={batch_engine.spec_k}"
-                 if batch_engine.spec_k else ""))
+                 if batch_engine.spec_k else "")
+              + (" (model drafter co-resident)"
+                 if batch_engine.drafter is not None else ""))
     else:
         from .dllama import check_kv_storage
 
+        if args.draft_model:
+            import sys
+
+            print("⚠️  --draft-model needs the batched verify path: add "
+                  "--batch N (N > 1). Serving WITHOUT model-based drafting.",
+                  file=sys.stderr)
         check_kv_storage(args)  # paged-mode cost notice (same as the CLI)
         engine = make_engine(args)
         sampler = make_sampler(args, engine.spec)

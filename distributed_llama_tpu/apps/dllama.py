@@ -114,6 +114,21 @@ def build_parser(include_mode: bool = True) -> argparse.ArgumentParser:
                         "greedy AND seeded-stochastic, token-identical "
                         "either way (docs/SERVING.md \"Speculative "
                         "decoding\"). No reference counterpart")
+    p.add_argument("--draft-model", default=None, metavar="PATH",
+                   help="model-based speculative drafting (api_server "
+                        "--batch > 1 only): load a second, small model from "
+                        "PATH (same .m format/loaders as --model, vocab "
+                        "must match), co-resident on the target's mesh, "
+                        "drafting k tokens per row in one scan dispatch "
+                        "with ADAPTIVE per-row k; n-gram lookup remains "
+                        "the per-row fallback (docs/SERVING.md "
+                        "\"Model-based drafting\"). Implies --speculative 8 "
+                        "when K is unset")
+    p.add_argument("--draft-k", type=int, default=0, metavar="K",
+                   help="cap the model drafter's per-row draft length "
+                        "(default: the --speculative K). The adaptive "
+                        "controller picks each row's k from the bucketed "
+                        "range [0, K]")
     p.add_argument("--trace", default=None, metavar="OUT.json",
                    help="record runtime spans (prefill chunks, decode "
                         "dispatches, super-steps, cold-attention callbacks) "
@@ -410,6 +425,12 @@ def main(argv=None) -> None:
 
     apply_platform_env()
     args = build_parser().parse_args(argv)
+    if args.draft_model:
+        import sys
+
+        print("⚠️  --draft-model needs the batched verify path — it is an "
+              "api_server --batch > 1 feature; the sequential CLI keeps "
+              "prompt-lookup drafting (--speculative).", file=sys.stderr)
     check_kv_storage(args)
     install_trace(args)
     from ..resilience import faults

@@ -1,0 +1,27 @@
+"""Model-based speculative drafting (docs/SERVING.md "Model-based drafting").
+
+A second, small sharded model co-resident on the target engine's mesh drafts
+k tokens per row in one `lax.scan` dispatch; the target's existing batched
+verify path (runtime/device_loop.py make_batched_verify_loop) then accepts or
+rejects the drafts with the usual byte-identity guarantees. Lazily importing
+(PEP 562) like the cache/fleet packages: importing the package costs nothing
+until a drafter is actually constructed.
+"""
+
+_EXPORTS = {
+    "ModelDrafter": ".drafter",
+    "make_draft_loop": ".loop",
+    "make_draft_step": ".loop",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(_EXPORTS[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)
+
+
+__all__ = list(_EXPORTS)

@@ -1,0 +1,112 @@
+"""Minimal stdlib completion client — the ONE request/SSE-read driver shared
+by bench.py's fleet workloads and perf/fault_matrix.py's family runners.
+
+Before this module the repo carried seven near-identical copies of the same
+loop (three fault-matrix request helpers, four bench SSE readers), each with
+its own drift opportunities around error events, chunked decoding, and
+header relay (churn explicitly deferred from PR 14). The driver reads the
+stream INCREMENTALLY (readline honors chunked decoding) so first-delta time
+is a true arrival time, and never raises: every failure mode lands in the
+returned dict's "error" field, which is what every caller wants — benches
+and fault cells assert on outcomes, they don't handle transport exceptions.
+
+Returned dict (fields None when not applicable):
+  status   HTTP status (None when the connection itself failed)
+  text     joined completion text ("" for an empty stream; None on failure)
+  finish   finish_reason (stream: last seen; non-stream: choice field)
+  error    None on success; SSE error payload / body / repr(exc) otherwise
+  rid      X-Request-Id response header (serving identity)
+  replica  X-Replica response header
+  ttft     seconds from request start to the FIRST delta (stream only)
+  e2e      seconds from request start to stream end
+  tpot     mean inter-delta gap seconds (stream, >= 2 deltas)
+  deltas   content-bearing SSE events seen
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+
+
+def completion_request(port: int, body: dict, *, host: str = "127.0.0.1",
+                       path: str = "/v1/chat/completions",
+                       timeout: float = 120.0, headers: dict | None = None,
+                       on_delta=None) -> dict:
+    """POST one chat completion and drain it (streaming when
+    body["stream"] is true). `on_delta(n, replica)` fires per
+    content-bearing SSE event with the running delta count — the hook the
+    chaos bench's mid-stream replica killer rides."""
+    out = {"status": None, "text": None, "finish": None, "error": None,
+           "rid": None, "replica": None, "ttft": None, "e2e": None,
+           "tpot": None, "deltas": 0}
+    t0 = time.perf_counter()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", path, json.dumps(body), hdrs)
+        resp = conn.getresponse()
+        out["status"] = resp.status
+        out["rid"] = resp.getheader("X-Request-Id")
+        out["replica"] = resp.getheader("X-Replica")
+        if not body.get("stream"):
+            data = resp.read()
+            if resp.status != 200:
+                try:
+                    out["error"] = json.loads(data or b"{}")
+                except ValueError:
+                    out["error"] = data.decode(errors="replace")
+                return out
+            payload = json.loads(data or b"{}")
+            choice = payload["choices"][0]
+            out["text"] = choice["message"]["content"]
+            out["finish"] = choice.get("finish_reason")
+            out["e2e"] = time.perf_counter() - t0
+            return out
+        if resp.status != 200:
+            out["error"] = resp.read().decode(errors="replace")
+            return out
+        text: list[str] = []
+        t_first = t_last = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            payload = json.loads(line[6:])
+            if "error" in payload:
+                out["error"] = payload["error"]
+                out["text"] = "".join(text)
+                return out
+            choice = payload["choices"][0]
+            if choice.get("finish_reason"):
+                out["finish"] = choice["finish_reason"]
+            d = choice["delta"].get("content")
+            if d:
+                now = time.perf_counter()
+                text.append(d)
+                out["deltas"] += 1
+                if t_first is None:
+                    t_first = now
+                    out["ttft"] = now - t0
+                t_last = now
+                if on_delta is not None:
+                    on_delta(out["deltas"], out["replica"])
+        out["text"] = "".join(text)
+        out["e2e"] = time.perf_counter() - t0
+        if out["deltas"] > 1:
+            out["tpot"] = (t_last - t_first) / (out["deltas"] - 1)
+        return out
+    except Exception as e:
+        out["error"] = repr(e)
+        return out
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass

@@ -78,7 +78,8 @@ from ..resilience.errors import (DeadlineExceeded, EngineClosed,
 from ..resilience.tenancy import (CLASSES, DEFAULT_TENANT, DrainRate,
                                   TenantRegistry, WeightedFairQueue)
 from .engine import PREFILL_CHUNKS, GenerationStats
-from .speculative import NgramIndex
+from .speculative import (AdaptiveK, NgramProposer, ProposerMux,
+                          verify_block_bucket)
 
 __all__ = ["BatchEngine", "BatchRequest"]
 
@@ -366,10 +367,9 @@ class _Slot:
         # mid-loop _finish must harvest the TRUNCATED history, not the
         # poisoned row (consumed by _harvest_into_cache / the post-loop clamp)
         self.clamp_pos: int | None = None
-        # speculative drafting corpus (spec_k > 0): an NgramIndex over the
-        # request's prompt + emitted tokens, appended per delivered token —
-        # the per-slot proposer behind batched draft-verify super-steps
-        self.ngram: NgramIndex | None = None
+        # speculative drafting state lives in the engine's Proposer
+        # (runtime/speculative.py): attached at admission, fed per delivered
+        # token, detached at finish/preempt — keyed by this slot's index
         # per-tenant token counter child, resolved ONCE at admission so the
         # per-token hot path (_emit) pays a bound-method call, not a label
         # dict lookup
@@ -430,6 +430,8 @@ class BatchEngine:
                  queue_ttl: float = 0.0, max_retries: int = 3,
                  retry_backoff: float = 0.05, speculative: int = 0,
                  spec_min_draft: int = 1, spec_chain_expect: float = 2.0,
+                 spec_adaptive: bool = True,
+                 draft_model=None, draft_k: int = 0,
                  tenants: TenantRegistry | None = None,
                  slo_ttft_interactive: float = 0.0,
                  slo_ttft_batch: float = 0.0,
@@ -539,6 +541,57 @@ class BatchEngine:
         # optimistic start: speculation engages immediately and the EMA
         # adapts down on non-repetitive workloads (updated per verify)
         self._spec_ema = float(self.spec_k)
+        # Model-based drafting (docs/SERVING.md "Model-based drafting"):
+        # draft_model (path, or a (spec, params) pair for tests) loads a
+        # second small sharded model CO-RESIDENT on this engine's mesh that
+        # drafts up to draft_k (default spec_k) tokens per row in one scan
+        # dispatch; n-gram lookup remains the per-row fallback (and the
+        # whole proposer when no drafter is configured, or its load fails —
+        # a drafter is an accelerator, never a correctness gate). The
+        # ADAPTIVE PER-ROW k controller (spec_adaptive, default on) drives
+        # each row's draft length from its own accept EMA, bucketed to the
+        # verify T buckets so adaptation cannot mint new compiled programs.
+        self.adaptive = (AdaptiveK(self.spec_k)
+                         if self.spec_k and spec_adaptive else None)
+        self.drafter = None
+        if draft_model is not None and self.spec_k and self._eng.dp > 1:
+            # the drafter's programs are tp-only (draft/loop.py) — gate at
+            # construction like the paged-KV dp/sp gate, instead of letting
+            # every proposal turn raise its way to the permanent disable
+            import sys
+
+            print("💡 --draft-model disabled: the drafter is tp-only and "
+                  "this engine shards rows over dp — using n-gram drafting",
+                  file=sys.stderr)
+            draft_model = None
+        if draft_model is not None and self.spec_k:
+            try:
+                from ..draft.drafter import ModelDrafter
+
+                dk = min(int(draft_k) or self.spec_k, self.spec_k)
+                if isinstance(draft_model, (tuple, list)):
+                    dspec, dparams = draft_model
+                    self.drafter = ModelDrafter(
+                        dspec, dparams, mesh=self._eng.mesh, slots=slots,
+                        target_spec=spec, tokenizer=tokenizer,
+                        dtype=self._eng.dtype,
+                        use_pallas=bool(self._eng.use_pallas),
+                        compress_collectives=self._eng.compress,
+                        moe_sharding=self._eng.moe_sharding, k_cap=dk)
+                else:
+                    self.drafter = ModelDrafter.load(
+                        str(draft_model), mesh=self._eng.mesh, slots=slots,
+                        target_spec=spec, tokenizer=tokenizer,
+                        dtype=self._eng.dtype,
+                        use_pallas=bool(self._eng.use_pallas),
+                        compress_collectives=self._eng.compress,
+                        moe_sharding=self._eng.moe_sharding, k_cap=dk)
+            except Exception as e:
+                import sys
+
+                print(f"⚠️  draft model unavailable ({e!r}) — degrading to "
+                      "n-gram drafting", file=sys.stderr, flush=True)
+        self.proposer = ProposerMux(NgramProposer(), self.drafter)
         self.prefilled_tokens = 0  # observability: total tokens run through prefill
         self.decode_steps = 0  # observability: batched device decode dispatches
         self.super_steps = 0  # observability: K-step fused dispatches (subset)
@@ -857,6 +910,36 @@ class BatchEngine:
                 "free_slots": self.slots_n - occupied,
                 "queue_depth": queued}
 
+    def spec_stats(self) -> dict | None:
+        """Speculative-decoding block for /v1/stats (docs/SERVING.md
+        "Model-based drafting"): engine-level accept counters plus the
+        proposer (which drafter is live, degradation state) and the
+        adaptive controller's per-row k breakdown. None when speculation is
+        off. Reads are lock-protected where the scheduler adapts
+        (AdaptiveK) and plain-counter snapshots elsewhere."""
+        if not self.spec_k:
+            return None
+        snap = metrics.snapshot()
+        drafted = snap.get("batch_spec_drafted_tokens_total", 0)
+        out = {
+            "k": self.spec_k,
+            "verify_steps": self.verify_steps,
+            "drafted_tokens": drafted,
+            "accepted_tokens": snap.get("batch_spec_accepted_tokens_total",
+                                        0),
+            "accept_rate": (snap.get("batch_spec_accepted_tokens_total", 0)
+                            / drafted if drafted else None),
+            "proposer": self.proposer.describe(),
+        }
+        if self.adaptive is not None:
+            out["adaptive"] = {
+                "k_cap": self.adaptive.k_cap,
+                "buckets": list(self.adaptive.buckets),
+                "rows": {str(r): v
+                         for r, v in self.adaptive.stats().items()},
+            }
+        return out
+
     def _dispatch_age(self) -> float:
         """Watchdog reading: 0 while nothing is in flight (an idle scheduler
         is not a hung one); otherwise seconds since the scheduler last made
@@ -934,6 +1017,9 @@ class BatchEngine:
                 req = s.req
                 s.req = None
                 s.pending = []
+                self.proposer.detach(s.index)
+                if self.adaptive is not None:
+                    self.adaptive.detach(s.index)
                 if req is not None and not req.done.is_set():
                     req.error = err
                     req.finish = "error"
@@ -966,6 +1052,10 @@ class BatchEngine:
                 eng._steps.clear()
                 eng._decode_loops.clear()
                 eng.k_cache, eng.v_cache = eng._init_cache()
+                if self.drafter is not None:
+                    # a zombie may still hold (and have donated) the
+                    # drafter's buffers — fresh caches, programs, row state
+                    self.drafter.reset_backend()
                 if self.kv_pool is not None:
                     # fresh pool arrays: every allocation and directory
                     # handle referenced the replaced buffers
@@ -1110,10 +1200,16 @@ class BatchEngine:
         best.next_token = None
         best.clamp_pos = None
         best.armed = False
-        # drafting corpus: the FULL prompt (including any reused prefix and
-        # preemption-delivered tokens) — prompt-lookup draws drafts from
-        # exactly that repetitive history
-        best.ngram = NgramIndex(full) if self.spec_k else None
+        # drafting corpus/frontier: the FULL prompt (including any reused
+        # prefix and preemption- or resume-delivered tokens) — the proposer
+        # (n-gram index and/or model-drafter row state) re-attaches whole,
+        # so preemption re-admission and durable resume need nothing special
+        if self.spec_k:
+            self.proposer.attach(best.index, full)
+            if self.adaptive is not None:
+                self.adaptive.attach(best.index)
+        else:
+            self.proposer.detach(best.index)
         # per-tenant delivery counter child, resolved once per admission so
         # the per-token _emit path pays no label lookup
         best.tok_counter = _TENANT_TOKENS.labels(
@@ -1514,7 +1610,9 @@ class BatchEngine:
         slot.req = None
         slot.pending = []
         slot.next_token = None
-        slot.ngram = None
+        self.proposer.detach(slot.index)
+        if self.adaptive is not None:
+            self.adaptive.detach(slot.index)
         slot.tok_counter = None
         # service-rate bookkeeping (docs/SERVING.md "Multi-tenant serving"):
         # one completion noted to the drain estimator — the denominator of
@@ -1780,7 +1878,9 @@ class BatchEngine:
         slot.req = None
         slot.pending = []
         slot.next_token = None
-        slot.ngram = None
+        self.proposer.detach(slot.index)
+        if self.adaptive is not None:
+            self.adaptive.detach(slot.index)
         slot.tok_counter = None
         harvest = None
         if self.prefix_cache is not None:
@@ -2046,8 +2146,9 @@ class BatchEngine:
             # (tests/test_resilience.py)
             faults.fire("batch.emit", slot=slot.index, n_out=len(req.out))
             req.out.append(token)
-            if slot.ngram is not None:  # corpus = prompt + delivered output
-                slot.ngram.append(token)
+            # proposer corpus/frontier sync: every DELIVERED token, in
+            # order (no-op for rows with no drafting state attached)
+            self.proposer.push(slot.index, token)
             req.stats.generated_tokens += 1
             _DECODE_TOKENS.inc()
             if slot.tok_counter is not None:  # per-tenant delivery share
@@ -2307,11 +2408,7 @@ class BatchEngine:
         would compile O(spec_k) programs; buckets bound it to O(log k).
         Padding positions are scratch writes beyond the frontier — the same
         masked-slot discipline every over-decode already relies on."""
-        cap = 1 + self.spec_k
-        b = 2
-        while b < t:
-            b = 2 * (b - 1) + 1
-        return min(b, cap)
+        return verify_block_bucket(t, 1 + self.spec_k)
 
     def _plan_verify(self, active: list[_Slot]):
         """Draft per-row proposals for one verify dispatch. Returns
@@ -2321,18 +2418,33 @@ class BatchEngine:
         the sequential loop (runtime/speculative.py): a row drafts at most
         min(k, max_tokens-room, context-room) so emitting the full accepted
         block never overruns max_tokens or the cache, and T shrinks so
-        every live row's T block writes stay inside seq_len."""
+        every live row's T block writes stay inside seq_len.
+
+        Per-row draft lengths additionally follow the ADAPTIVE controller
+        (docs/SERVING.md "Model-based drafting"): each row's cap is its own
+        accept-EMA bucket — a chat row that accepts 2-long drafts stops
+        paying for 8-wide ones, a row whose EMA collapses disengages
+        entirely (k=0, re-probing on the slow-reprobe horizon) — while
+        proposals come from the engine's Proposer (model drafter when
+        configured and able, n-gram lookup otherwise), all rows served in
+        one propose_batch call so a model drafter drafts every row in ONE
+        scan dispatch."""
         s = self.spec.seq_len
-        drafts: dict[int, list[int]] = {}
-        total = 0
-        max_pos = 0
+        want: dict[int, int] = {}
         for slot in active:
             req = slot.req
             cap = min(self.spec_k, req.max_tokens - len(req.out) - 1,
                       s - slot.pos - 2)
-            d = (slot.ngram.propose_extended(cap)
-                 if (cap > 0 and slot.ngram) else [])
-            drafts[slot.index] = d
+            if self.adaptive is not None:
+                cap = min(cap, self.adaptive.k_for(slot.index))
+            want[slot.index] = cap
+        drafts = self.proposer.propose_batch(
+            {i: c for i, c in want.items() if c > 0})
+        total = 0
+        max_pos = 0
+        for slot in active:
+            d = drafts.setdefault(slot.index, [])
+            del d[max(want[slot.index], 0):]  # never outdraft the caps
             total += len(d)
             max_pos = max(max_pos, slot.pos)
         if total < self.spec_min_draft:
@@ -2449,11 +2561,14 @@ class BatchEngine:
     def _drafts_ready(self, rows: list) -> bool:
         """Cheap probe: would a verify dispatch have material to work with?
         Consulted by the accept-aware chain policy BEFORE the in-flight
-        block delivers, so it sees the pre-block corpus — advisory only."""
+        block delivers, so it sees the pre-block corpus — advisory only
+        (a model drafter counts as ready whenever its row can run: it
+        always drafts k tokens, that is the point of it)."""
         for slot, _req in rows:
-            ng = slot.ngram
-            if ng is not None and len(ng.propose_extended(self.spec_k)) >= \
-                    self.spec_min_draft:
+            k = (self.adaptive.k_for(slot.index)
+                 if self.adaptive is not None else self.spec_k)
+            if k > 0 and self.proposer.ready(slot.index, k,
+                                             self.spec_min_draft):
                 return True
         return False
 
@@ -2762,6 +2877,13 @@ class BatchEngine:
                 nd = fl.ndraft[i]
                 a = b - 1
                 accs.append(a)
+                # per-row adaptation + per-proposer attribution: a drafting
+                # row's EMA follows its accept; a row that rode draftless
+                # ticks toward re-probe (docs/SERVING.md "Model-based
+                # drafting")
+                if self.adaptive is not None:
+                    self.adaptive.observe(i, nd, a)
+                self.proposer.observe(i, a)
                 req.stats.spec_steps += 1
                 req.stats.spec_drafted += nd
                 req.stats.spec_accepted += a
@@ -2875,6 +2997,11 @@ class BatchEngine:
             # per dozen scans while phase changes (output turning repetitive
             # mid-stream) are picked up within the same horizon
             self._spec_ema += 0.05 * (self.spec_k - self._spec_ema)
+            if self.adaptive is not None:
+                # the same slow-reprobe policy PER ROW: a scan turn passed
+                # without these rows drafting
+                for slot, _req in fl.rows:
+                    self.adaptive.tick(slot.index)
         return status
 
     def _chain_divergence(self, nxt: _InflightStep,

@@ -175,6 +175,306 @@ class NgramIndex:
         return out[:k]
 
 
+# ----------------------------------------------------------------------
+# Proposer protocol (docs/SERVING.md "Model-based drafting")
+# ----------------------------------------------------------------------
+# A proposer supplies per-row draft tokens to the BatchEngine's verify path.
+# Implementations: NgramProposer (prompt-lookup, below), draft/drafter.py
+# ModelDrafter (a co-resident small sharded model), and ProposerMux (per-row
+# routing between them). All methods run on the scheduler thread unless a
+# class documents otherwise; `row` is the engine slot index.
+#
+#   name: str                      # "ngram" | "model" | "mux" (stats/metrics)
+#   attach(row, tokens)            # bind a row; tokens = prompt ⊕ delivered
+#   detach(row)                    # release the row (finish/preempt/wedge)
+#   push(row, tok)                 # one delivered token (corpus/frontier sync)
+#   propose(row, k) -> list[int]   # up to k draft tokens for the row
+#   observe(row, accepted)         # verify outcome for the row's last drafts
+#
+# propose_batch(want: {row: k}) -> {row: drafts} is the batched form the
+# engine actually calls (a model drafter serves every row in ONE scan
+# dispatch); the default below routes it through per-row propose().
+
+
+def verify_block_bucket(t: int, cap: int) -> int:
+    """Block-length bucket (2, 3, 5, 9, 17, ... capped at `cap`): verify and
+    draft-scan programs compile per length, so raw per-dispatch lengths would
+    compile O(k) programs; buckets bound it to O(log k). Padding positions
+    are scratch writes beyond the frontier — the same masked-slot discipline
+    every over-decode already relies on."""
+    b = 2
+    while b < t:
+        b = 2 * (b - 1) + 1
+    return min(b, cap)
+
+
+def draft_buckets(k_cap: int) -> list[int]:
+    """Per-row draft-count buckets derived from the verify T buckets
+    (T = 1 + k: k ∈ 1, 2, 4, 8, ...), capped at k_cap — the adaptive-k
+    controller only ever requests these lengths, so per-row adaptation can
+    never mint a verify (or drafter-scan) program the fixed-k path would
+    not also compile."""
+    out = []
+    b = 1
+    while b < k_cap:
+        out.append(b)
+        b *= 2
+    out.append(k_cap)
+    return out
+
+
+class NgramProposer:
+    """Per-row NgramIndex behind the Proposer protocol — the PR-8 prompt-
+    lookup drafter re-expressed as one implementation among several."""
+
+    name = "ngram"
+
+    def __init__(self, *, max_ngram: int = 4, max_entries: int = 65536):
+        self.max_ngram = max_ngram
+        self.max_entries = max_entries
+        self._idx: dict[int, NgramIndex] = {}
+
+    def attach(self, row: int, tokens: list[int]) -> None:
+        self._idx[row] = NgramIndex(list(tokens), max_ngram=self.max_ngram,
+                                    max_entries=self.max_entries)
+
+    def detach(self, row: int) -> None:
+        self._idx.pop(row, None)
+
+    def push(self, row: int, tok: int) -> None:
+        idx = self._idx.get(row)
+        if idx is not None:
+            idx.append(tok)
+
+    def propose(self, row: int, k: int) -> list[int]:
+        idx = self._idx.get(row)
+        if idx is None or k <= 0:
+            return []
+        return idx.propose_extended(k)
+
+    def propose_batch(self, want: dict[int, int]) -> dict[int, list[int]]:
+        return {row: d for row, k in want.items()
+                if (d := self.propose(row, k))}
+
+    def observe(self, row: int, accepted: int) -> None:
+        pass  # the corpus already advanced via push()
+
+    def ready(self, row: int, k: int, min_draft: int) -> bool:
+        """Cheap advisory probe: would propose() return >= min_draft?"""
+        return len(self.propose(row, k)) >= min_draft
+
+
+class AdaptiveK:
+    """Per-row adaptive draft length (docs/SERVING.md "Model-based
+    drafting"): each row's k follows its own accept EMA so chat, code, json
+    and open-ended rows co-batched in one engine each find their own
+    operating point. k values are drawn from draft_buckets() (the verify
+    T buckets minus 1) so adaptation cannot cause recompile creep.
+
+    Policy per verify turn (observe): full accept counts as accepted+1 —
+    the row would likely have accepted more, so the EMA can climb past the
+    current bucket and k grows; a partial accept pulls the EMA toward the
+    measured accept length and k shrinks to the smallest bucket covering
+    it. Below `engage` the row DISENGAGES (k_for -> 0: no drafts, no wasted
+    verify width); while disengaged — and on any turn the row passes
+    without drafting (tick) — the EMA regresses slowly UP toward
+    `reprobe_to` (just past the engage floor, never dragging an
+    already-confident row down): the PR-8 slow-reprobe policy per row, so
+    after ~a dozen idle turns the row re-probes with the SMALLEST bucket
+    (one cheap draft) and only ramps back up if the probe accepts —
+    a hopeless row (e.g. a high-temperature stochastic stream sampling far
+    from the drafter's argmax) costs one 1-token draft per horizon instead
+    of riding every verify at full width."""
+
+    def __init__(self, k_cap: int, *, alpha: float = 0.3,
+                 engage: float = 0.35, reprobe: float = 0.05):
+        self.k_cap = max(int(k_cap), 1)
+        self.buckets = draft_buckets(self.k_cap)
+        self.alpha = alpha
+        self.engage = engage
+        self.reprobe = reprobe
+        self.reprobe_to = 2.0 * engage  # re-probe lands on the k=1 bucket
+        import threading
+
+        # stats() is read from API threads while the scheduler adapts
+        self._lock = threading.Lock()  # guards: _ema
+        self._ema: dict[int, float] = {}
+
+    def attach(self, row: int) -> None:
+        with self._lock:
+            # optimistic start (the PR-8 engine-EMA convention): speculation
+            # engages at full width and adapts down on hopeless rows
+            self._ema[row] = float(self.k_cap) + 1.0
+
+    def detach(self, row: int) -> None:
+        with self._lock:
+            self._ema.pop(row, None)
+
+    def _k_from_ema(self, ema: float) -> int:
+        """The one place the engage threshold + bucket choice live (k_for
+        and stats() must report the same policy)."""
+        if ema < self.engage:
+            return 0
+        for b in self.buckets:
+            if b >= ema:
+                return b
+        return self.k_cap
+
+    def k_for(self, row: int) -> int:
+        with self._lock:
+            ema = self._ema.get(row)
+        if ema is None:
+            return self.k_cap  # unattached rows get the fixed-k behavior
+        return self._k_from_ema(ema)
+
+    def observe(self, row: int, drafted: int, accepted: int) -> None:
+        if drafted <= 0:
+            return self.tick(row)
+        val = accepted + 1.0 if accepted >= drafted else float(accepted)
+        with self._lock:
+            if row in self._ema:
+                self._ema[row] += self.alpha * (val - self._ema[row])
+
+    def tick(self, row: int) -> None:
+        """A turn passed without this row drafting (scan, or rode a verify
+        draftless): regress slowly up toward the re-probe point so
+        disengagement is never forever — and never drag a confident row's
+        EMA down (a row paused only because its proposer went dry must not
+        forget its accept history)."""
+        with self._lock:
+            ema = self._ema.get(row)
+            if ema is not None and ema < self.reprobe_to:
+                self._ema[row] = ema + self.reprobe * (self.reprobe_to - ema)
+
+    def stats(self) -> dict[int, dict]:
+        with self._lock:
+            snap = dict(self._ema)
+        return {row: {"ema": round(ema, 3), "k": self._k_from_ema(ema)}
+                for row, ema in snap.items()}
+
+
+class ProposerMux:
+    """Per-row routing between a model drafter and the n-gram fallback
+    (docs/SERVING.md "Model-based drafting"). The drafter serves every row
+    it can (attached, within its own context window, healthy) in one scan
+    dispatch; remaining rows fall back to prompt lookup. A raising drafter
+    degrades: the failing dispatch's rows fall back to n-gram proposals
+    (the request never sees the failure), and `max_failures` CONSECUTIVE
+    propose failures disable the drafter for the engine's lifetime —
+    n-gram-only from then on, exactly the pre-drafter behavior.
+
+    Scheduler-thread-only except stats()/describe() (reads of counters and
+    the drafter's own locked stats — torn reads only skew a stats scrape)."""
+
+    name = "mux"
+
+    def __init__(self, ngram: NgramProposer, drafter=None, *,
+                 max_failures: int = 8):
+        self.ngram = ngram
+        self.drafter = drafter
+        self.max_failures = max_failures
+        self.failures = 0  # consecutive; reset on success
+        self.errors = 0  # lifetime (stats)
+        self.disabled = False
+        # which proposer drafted each row's LAST proposal (per-proposer
+        # accept attribution; scheduler-thread-only)
+        self.last_src: dict[int, str] = {}
+
+    def _model_ok(self) -> bool:
+        return self.drafter is not None and not self.disabled
+
+    def attach(self, row: int, tokens: list[int]) -> None:
+        self.ngram.attach(row, tokens)
+        if self.drafter is not None:
+            self.drafter.attach(row, tokens)
+
+    def detach(self, row: int) -> None:
+        self.ngram.detach(row)
+        if self.drafter is not None:
+            self.drafter.detach(row)
+        self.last_src.pop(row, None)
+
+    def push(self, row: int, tok: int) -> None:
+        self.ngram.push(row, tok)
+        if self.drafter is not None:
+            self.drafter.push(row, tok)
+
+    def propose(self, row: int, k: int) -> list[int]:
+        return self.propose_batch({row: k}).get(row, [])
+
+    def propose_batch(self, want: dict[int, int]) -> dict[int, list[int]]:
+        out: dict[int, list[int]] = {}
+        if self._model_ok():
+            try:
+                out = self.drafter.propose_batch(want)
+                self.failures = 0
+            except Exception as e:
+                # a failing drafter costs only its drafts — every row falls
+                # back to prompt lookup below, the request never notices
+                self.failures += 1
+                self.errors += 1
+                _DRAFT_ERRORS.inc()
+                if self.failures >= self.max_failures and not self.disabled:
+                    self.disabled = True
+                    _DRAFT_DISABLED.set(1)
+                    import sys
+
+                    print(f"🔴 model drafter disabled after "
+                          f"{self.failures} consecutive failures: {e!r} — "
+                          "degrading to n-gram drafting", file=sys.stderr)
+                out = {}
+        for row, d in out.items():
+            self.last_src[row] = "model"
+            _PROPOSED.labels(proposer="model").inc(len(d))
+        for row, k in want.items():
+            if row in out:
+                continue
+            d = self.ngram.propose(row, k)
+            if d:
+                out[row] = d
+                self.last_src[row] = "ngram"
+                _PROPOSED.labels(proposer="ngram").inc(len(d))
+        return out
+
+    def observe(self, row: int, accepted: int) -> None:
+        src = self.last_src.get(row)
+        if src is not None and accepted > 0:
+            _PROP_ACCEPTED.labels(proposer=src).inc(accepted)
+        if self.drafter is not None:
+            self.drafter.observe(row, accepted)
+
+    def ready(self, row: int, k: int, min_draft: int) -> bool:
+        if k <= 0:
+            return False
+        if self._model_ok() and self.drafter.can_serve(row, k):
+            return True  # a model drafts k tokens whenever it can run
+        return self.ngram.ready(row, k, min_draft)
+
+    def describe(self) -> dict:
+        d = self.drafter
+        out = {"model": d is not None, "disabled": self.disabled,
+               "errors": self.errors}
+        if d is not None:
+            out["drafter"] = d.stats()
+        return out
+
+
+_PROPOSED = metrics.counter(
+    "batch_spec_proposer_drafted_total",
+    "Draft tokens fed to batched verify dispatches, by proposer",
+    labelnames=("proposer",))
+_PROP_ACCEPTED = metrics.counter(
+    "batch_spec_proposer_accepted_total",
+    "Accepted draft tokens, by the proposer that drafted them",
+    labelnames=("proposer",))
+_DRAFT_ERRORS = metrics.counter(
+    "batch_draft_errors_total",
+    "Model-drafter propose failures degraded to n-gram drafting")
+_DRAFT_DISABLED = metrics.gauge(
+    "batch_draft_disabled",
+    "1 while the model drafter is disabled after consecutive failures")
+
+
 def generate_speculative(engine, prompt_tokens: list[int], max_tokens: int,
                          sampler, *, k: int = 8, on_token=None,
                          stop_check=None,

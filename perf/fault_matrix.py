@@ -115,6 +115,21 @@ FAIRNESS_CELLS = len(FAIRNESS_SCENARIOS) * 2  # × {pipelined, serialized}
 GRAY_MODES = ("route", "timeout", "hedge")
 GRAY_CELLS = len(GRAY_MODES) * 2  # × {stream, nonstream}
 
+# Drafter family (ISSUE 15, docs/SERVING.md "Model-based drafting"): a
+# failing model drafter must DEGRADE — to n-gram drafting for rows prompt
+# lookup can serve, to plain decode for the rest — and never surface to a
+# client: byte-identity is the verify path's contract regardless of where
+# proposals come from. draft.load cells build the engine under injection
+# (error -> the drafter is dropped at construction, n-gram-only engine);
+# draft.propose / draft.dispatch cells inject into a live drafter's
+# proposal turns (error -> that dispatch's rows fall back to n-gram, the
+# ProposerMux failure counter advances — asserted, so the cells can't go
+# vacuous). Kinds: error + latency (a transient drafter is just a slow
+# one — retries are not part of the proposal path, degradation is).
+DRAFT_POINTS = ("draft.load", "draft.propose", "draft.dispatch")
+DRAFT_KINDS = ("error", "latency")
+DRAFT_CELLS = len(DRAFT_POINTS) * len(DRAFT_KINDS) * 2  # × {pipe, serial}
+
 
 def _spec(seq_len=128):
     return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
@@ -213,6 +228,139 @@ def run_spec_cell(spec, be, point: str, kind: str, refs: dict) -> list[str]:
     else:
         problems.append(f"{point}/{kind}: slot/lease leak after probe")
     return problems
+
+
+def build_draft_engine(pipeline: bool):
+    """Target engine + a small RANDOM co-resident drafter (its drafts
+    mostly miss — irrelevant here: the family tests degradation, not
+    speedup; byte-identity holds for any proposal content)."""
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    dspec = ModelSpec(arch_type=ArchType.LLAMA, dim=32, hidden_dim=64,
+                      n_layers=1, n_heads=2, n_kv_heads=2, vocab_size=256,
+                      seq_len=128, rope_type=RopeType.LLAMA).resolved()
+    dparams = init_random_params(dspec, FloatType.Q40, seed=5)
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                     pipeline=pipeline, speculative=4,
+                     draft_model=(dspec, dparams))
+    return spec, be
+
+
+# one repetition-heavy prompt (n-gram can serve it when the drafter dies)
+# and one structureless prompt (prompt lookup is dry there — a dead drafter
+# leaves it PLAIN DECODE, the second rung of the degradation ladder)
+DRAFT_PROMPTS = ([1] + SPEC_PAT * 3,
+                 [1, 17, 93, 4, 55, 201, 8, 41, 113, 29])
+DRAFT_GEN = 24
+
+
+def run_draft_cell(spec, be, point: str, kind: str, refs: dict,
+                   tag: str) -> list[str]:
+    """One live-drafter cell: inject at `point` while drafter-backed
+    requests decode. NO client-visible failure is acceptable — a drafter
+    is an accelerator: its faults cost proposals (mux degrades that
+    dispatch to n-gram), never correctness — and every output must equal
+    the fault-free reference byte-for-byte."""
+    problems: list[str] = []
+    errs0 = be.proposer.errors
+    with faults.active(_spec_for(point, kind)):
+        reqs = [(p, be.submit(list(p), DRAFT_GEN, _greedy(spec)))
+                for p in DRAFT_PROMPTS]
+        for p, r in reqs:
+            try:
+                out = r.wait(timeout=120)
+            except Exception as e:
+                problems.append(f"draft {tag} {point}/{kind}: "
+                                f"client-visible failure {e!r}")
+                continue
+            if r.error is not None:
+                problems.append(f"draft {tag} {point}/{kind}: request "
+                                f"errored {r.error!r}")
+            elif out != refs[tuple(p)]:
+                problems.append(f"draft {tag} {point}/{kind}: output "
+                                f"diverged from fault-free reference")
+    faults.uninstall()
+    if kind == "error" and be.proposer.errors == errs0:
+        problems.append(f"draft {tag} {point}/{kind}: fault never reached "
+                        "the drafter (vacuous cell)")
+    if be.proposer.disabled:
+        problems.append(f"draft {tag} {point}/{kind}: bounded fault "
+                        "disabled the drafter permanently")
+    if not be.scheduler_alive():
+        problems.append(f"draft {tag} {point}/{kind}: scheduler DIED")
+        return problems
+    try:
+        probe = be.submit(list(DRAFT_PROMPTS[0]), DRAFT_GEN, _greedy(spec))
+        out = probe.wait(timeout=120)
+        if out != refs[tuple(DRAFT_PROMPTS[0])] or probe.error is not None:
+            problems.append(f"draft {tag} {point}/{kind}: probe degraded")
+    except Exception as e:
+        problems.append(f"draft {tag} {point}/{kind}: probe failed: {e!r}")
+    with be._plock:
+        leaked = [s for s in be._slots
+                  if s.req is not None or s.lease is not None]
+    if leaked:
+        problems.append(f"draft {tag} {point}/{kind}: slot/lease leak")
+    return problems
+
+
+def run_draft_load_cell(pipeline: bool, kind: str, refs: dict,
+                        tag: str) -> list[str]:
+    """draft.load cell: the engine is CONSTRUCTED under injection. An
+    error must drop the drafter (n-gram-only engine, outputs unchanged);
+    latency must merely delay construction."""
+    problems: list[str] = []
+    with faults.active(FaultSpec("draft.load", kind=kind, count=1,
+                                 delay_ms=10)):
+        spec, be = build_draft_engine(pipeline)
+    faults.uninstall()
+    try:
+        if kind == "error" and be.drafter is not None:
+            problems.append(f"draft {tag} load/{kind}: drafter survived an "
+                            "injected load failure (vacuous cell)")
+        if kind == "latency" and be.drafter is None:
+            problems.append(f"draft {tag} load/{kind}: a slow load dropped "
+                            "the drafter")
+        for p in DRAFT_PROMPTS:
+            r = be.submit(list(p), DRAFT_GEN, _greedy(spec))
+            out = r.wait(timeout=120)
+            if r.error is not None:
+                problems.append(f"draft {tag} load/{kind}: request errored "
+                                f"{r.error!r}")
+            elif out != refs[tuple(p)]:
+                problems.append(f"draft {tag} load/{kind}: output diverged "
+                                "from fault-free reference")
+    except Exception as e:
+        problems.append(f"draft {tag} load/{kind}: {e!r}")
+    finally:
+        be.close()
+    return problems
+
+
+def run_draft_family() -> tuple[int, list[str]]:
+    cells = 0
+    problems: list[str] = []
+    for pipeline in (True, False):
+        tag = "pipelined" if pipeline else "serialized"
+        spec, be = build_draft_engine(pipeline)
+        try:
+            refs = {}
+            for p in DRAFT_PROMPTS:
+                refs[tuple(p)] = be.submit(list(p), DRAFT_GEN,
+                                           _greedy(spec)).wait(timeout=120)
+            for point in ("draft.propose", "draft.dispatch"):
+                for kind in DRAFT_KINDS:
+                    cells += 1
+                    problems += run_draft_cell(spec, be, point, kind, refs,
+                                               tag)
+        finally:
+            be.close()
+        for kind in DRAFT_KINDS:
+            cells += 1
+            problems += run_draft_load_cell(pipeline, kind, refs, tag)
+    return cells, problems
 
 
 def build_engine(paged: bool = False):
@@ -666,49 +814,17 @@ def build_durable_fleet(speculative: int = 0, router_kwargs: dict = None):
 
 
 def _durability_request(rport: int, stream: bool) -> dict:
-    """One completion through the router; returns {text, error, status}.
-    The repetitive content makes n-gram drafts engage on spec engines."""
-    import http.client
-    import json as _json
+    """One completion through the router; returns the shared driver's
+    outcome dict (fleet/client.py — text/error/status are what the cells
+    assert on). The repetitive content makes n-gram drafts engage on spec
+    engines."""
+    from distributed_llama_tpu.fleet.client import completion_request
 
     body = {"messages": [
         {"role": "system", "content": "shared fleet system prompt abcb abcb"},
         {"role": "user", "content": "ab ab ab ab ab ab ab ab"}],
         "max_tokens": 48, "temperature": 0.8, "seed": 4242, "stream": stream}
-    conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
-    try:
-        conn.request("POST", "/v1/chat/completions", _json.dumps(body),
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        if not stream:
-            data = _json.loads(resp.read() or b"{}")
-            if resp.status != 200:
-                return {"text": None, "error": data, "status": resp.status}
-            return {"text": data["choices"][0]["message"]["content"],
-                    "error": None, "status": 200}
-        if resp.status != 200:
-            return {"text": None, "error": resp.read().decode(),
-                    "status": resp.status}
-        text, err = [], None
-        while True:
-            line = resp.readline()
-            if not line:
-                break
-            line = line.decode().strip()
-            if not line.startswith("data: ") or line == "data: [DONE]":
-                continue
-            payload = _json.loads(line[6:])
-            if "error" in payload:
-                err = payload["error"]
-                break
-            d = payload["choices"][0]["delta"].get("content")
-            if d:
-                text.append(d)
-        return {"text": "".join(text), "error": err, "status": 200}
-    except Exception as e:
-        return {"text": None, "error": repr(e), "status": None}
-    finally:
-        conn.close()
+    return completion_request(rport, body, timeout=120)
 
 
 def _start_killer(reps, min_tokens: int = 3):
@@ -889,45 +1005,15 @@ def _disagg_request(rport: int, stream: bool, seed=None,
     BOUNDED-ERROR KV in the decode replica's directory by design, so a
     later same-prompt request would legitimately decode from degraded
     rows — byte-identity cells must not share prompts across wire modes."""
+    from distributed_llama_tpu.fleet.client import completion_request
+
     body = {"messages": [
         {"role": "system", "content": "s" * 64},
         {"role": "user", "content": f"tell me something {salt}"}],
         "max_tokens": 10, "temperature": 0, "stream": stream}
     if seed is not None:
         body.update(temperature=0.9, seed=seed)
-    import http.client
-    import json as _json
-
-    conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
-    try:
-        conn.request("POST", "/v1/chat/completions", _json.dumps(body),
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        if not stream:
-            data = _json.loads(resp.read() or b"{}")
-            if resp.status != 200:
-                return {"text": None, "error": data, "status": resp.status}
-            return {"text": data["choices"][0]["message"]["content"],
-                    "error": None, "status": 200}
-        if resp.status != 200:
-            return {"text": None, "error": resp.read().decode(),
-                    "status": resp.status}
-        text, err = [], None
-        for line in resp.read().decode().splitlines():
-            if not line.startswith("data: ") or line == "data: [DONE]":
-                continue
-            payload = _json.loads(line[6:])
-            if "error" in payload:
-                err = payload["error"]
-                break
-            d = payload["choices"][0]["delta"].get("content")
-            if d:
-                text.append(d)
-        return {"text": "".join(text), "error": err, "status": 200}
-    except Exception as e:
-        return {"text": None, "error": repr(e), "status": None}
-    finally:
-        conn.close()
+    return completion_request(rport, body, timeout=120)
 
 
 def _disagg_leak_check(be, tag: str) -> list[str]:
@@ -1081,8 +1167,7 @@ def _gray_request(rport: int, stream: bool, seed=None, salt: str = "",
     granular: a shared 16-byte prefix block still pins). Scattered requests
     are liveness probes only (their text depends on the prompt, so identity
     is asserted on the fixed-prompt requests)."""
-    import http.client
-    import json as _json
+    from distributed_llama_tpu.fleet.client import completion_request
 
     body = {"messages": [
         {"role": "system", "content": scatter or "gray fleet system prompt"},
@@ -1090,40 +1175,7 @@ def _gray_request(rport: int, stream: bool, seed=None, salt: str = "",
         "max_tokens": 6, "temperature": 0, "stream": stream}
     if seed is not None:
         body.update(temperature=0.9, seed=seed)
-    conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=120)
-    try:
-        conn.request("POST", "/v1/chat/completions", _json.dumps(body),
-                     {"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        if not stream:
-            data = _json.loads(resp.read() or b"{}")
-            if resp.status != 200:
-                return {"text": None, "error": data, "status": resp.status}
-            return {"text": data["choices"][0]["message"]["content"],
-                    "error": None, "status": 200}
-        if resp.status != 200:
-            return {"text": None, "error": resp.read().decode(),
-                    "status": resp.status}
-        text, err = [], None
-        while True:
-            line = resp.readline()
-            if not line:
-                break
-            line = line.decode().strip()
-            if not line.startswith("data: ") or line == "data: [DONE]":
-                continue
-            payload = _json.loads(line[6:])
-            if "error" in payload:
-                err = payload["error"]
-                break
-            d = payload["choices"][0]["delta"].get("content")
-            if d:
-                text.append(d)
-        return {"text": "".join(text), "error": err, "status": 200}
-    except Exception as e:
-        return {"text": None, "error": repr(e), "status": None}
-    finally:
-        conn.close()
+    return completion_request(rport, body, timeout=120)
 
 
 def run_gray_mode(state, reps, rport: int, victim, mode: str,
@@ -1353,6 +1405,12 @@ def run_matrix(include_paged: bool = True,
     y_cells, y_problems = run_gray_family()
     cells += y_cells
     problems += y_problems
+    # model drafter: load/propose/dispatch failures degrade to n-gram
+    # then plain decode, never a client failure (ISSUE 15,
+    # docs/SERVING.md "Model-based drafting")
+    d_cells, d_problems = run_draft_family()
+    cells += d_cells
+    problems += d_problems
     return cells, problems
 
 

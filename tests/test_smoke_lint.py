@@ -62,6 +62,20 @@ def test_scan_covers_resilience_package():
     assert os.path.join("perf", "fault_matrix.py") in rel
 
 
+def test_scan_covers_draft_package():
+    """The model-drafting subsystem (ISSUE 15, mirroring the cache/ and
+    fleet/ coverage tests): the drafter, its device loop, and the shared
+    fleet completion client must ride the repo-wide compile + dead-import
+    gate."""
+    files = smoke_lint.repo_py_files()
+    rel = {os.path.relpath(f, smoke_lint.REPO) for f in files}
+    for mod in ("drafter", "loop", "__init__"):
+        assert os.path.join("distributed_llama_tpu", "draft",
+                            f"{mod}.py") in rel, mod
+    assert os.path.join("distributed_llama_tpu", "fleet",
+                        "client.py") in rel
+
+
 def test_metric_names_documented():
     """ISSUE 7 satellite: every metrics.counter/gauge/histogram name
     registered anywhere in the package must appear in
