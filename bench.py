@@ -66,6 +66,7 @@ from distributed_llama_tpu.parallel.mesh import make_mesh  # noqa: E402
 from distributed_llama_tpu.parallel.tp import (  # noqa: E402
     init_sharded_kv_cache, make_sharded_forward, shard_params)
 from distributed_llama_tpu.obs import trace as obs_trace  # noqa: E402
+from distributed_llama_tpu.ops.matmul import kernel_selections  # noqa: E402
 from distributed_llama_tpu.ops.pallas_prologue import (  # noqa: E402
     prologue_supported)
 from distributed_llama_tpu.fleet.client import completion_request  # noqa: E402
@@ -281,7 +282,8 @@ def synth_q40(key, shape, layout: str):
     return QTensor(FloatType.Q40, packed, scales)
 
 
-def synth_params(spec: ModelSpec, layout: str, fuse: bool = True, tp: int = 1):
+def synth_params(spec: ModelSpec, layout: str, fuse: bool = True, tp: int = 1,
+                 keep_gate_pair: bool = False):
     from distributed_llama_tpu.models.params import _FUSE_GROUPS
     from distributed_llama_tpu.parallel.sharding import effective_kv_heads
 
@@ -297,6 +299,11 @@ def synth_params(spec: ModelSpec, layout: str, fuse: bool = True, tp: int = 1):
             if not all(n in shapes for n in members):
                 continue
             if fused_name == "wqkv" and effective_kv_heads(spec, tp) != spec.n_kv_heads:
+                continue
+            if fused_name == "w13" and keep_gate_pair:
+                # the gated-epilogue kernel fuses across the SEPARATE w1/w3
+                # pair (prepare_for_pallas keep_gate_pair) — merging them
+                # here would shape-gate it off in every --fused-matmul run
                 continue
             lead = shapes[members[0]][0][:-2]  # MoE stacks carry an E axis
             rows = sum(shapes[n][0][-2] for n in members)
@@ -2653,6 +2660,12 @@ def main():
                     help="fused dequant-matmul for M>1 (ops/pallas_q4_mm.py): "
                          "weights stream once at 4-bit density instead of the "
                          "XLA dequant path — opt-in until the hardware A/B lands")
+    ap.add_argument("--fused-matmul", action="store_true",
+                    help="batched fused-epilogue kernels (use_pallas='fused', "
+                         "the Engine --fused-matmul / DLT_FUSED_MATMUL lever): "
+                         "everything --prefill-kernel enables plus the "
+                         "residual-add and silu·mul gate-pair epilogues; keeps "
+                         "w1/w3 as the separate pair the gated kernel needs")
     args = ap.parse_args()
 
     if args.trace:
@@ -2675,7 +2688,8 @@ def main():
         getattr(args, k) == ap.get_default(k)
         for k in ("small", "arch", "prefill", "device_loop", "layout", "tp",
                   "window", "cache_write", "no_fuse", "prologue",
-                  "prefill_kernel", "kv_paged", "batch", "superstep", "trace",
+                  "prefill_kernel", "fused_matmul", "kv_paged", "batch",
+                  "superstep", "trace",
                   "workload", "pipeline", "replicas", "speculative")
     ) and not os.environ.get("DLT_FORCE_I4P_FAILURE")
     if args.batch > 0 and (args.prefill > 0 or args.device_loop > 0
@@ -2989,7 +3003,8 @@ def main():
 
     def build(lay):
         params = shard_params(
-            synth_params(spec, lay, fuse=not args.no_fuse, tp=args.tp), mesh, spec)
+            synth_params(spec, lay, fuse=not args.no_fuse, tp=args.tp,
+                         keep_gate_pair=args.fused_matmul), mesh, spec)
         state.update(params=params, layout=lay,
                      wbytes=decode_stream_bytes(params, spec))
         kc, vc = init_sharded_kv_cache(spec, mesh, batch=max(args.batch, 1),
@@ -3024,8 +3039,9 @@ def main():
         and turned round 3's lowering failure into RESOURCE_EXHAUSTED
         (BENCH_r03.json). Capture the message only, clear the traceback, and
         gc.collect() before re-synthesizing."""
-        ladder = [(layout, args.cache_write, args.prologue, args.prefill_kernel)]
-        if args.prefill_kernel:
+        kern = "fused" if args.fused_matmul else args.prefill_kernel
+        ladder = [(layout, args.cache_write, args.prologue, kern)]
+        if kern:
             # dequant-matmul failure alone: drop it first, keep everything else
             ladder.append((layout, args.cache_write, args.prologue, False))
         if args.prologue:
@@ -3043,13 +3059,17 @@ def main():
         for attempt, (lay, cw, prol, pk) in enumerate(ladder):
             state["cache_write"] = cw
             state["prologue"] = prol
-            state["use_pallas"] = ("all" if (pk and on_tpu) else on_tpu)
+            # pk is the kernel-policy rung: "fused" > True ("all") > False;
+            # off-TPU both collapse to the interpret-gated boolean
+            state["use_pallas"] = (
+                ("fused" if pk == "fused" else "all") if (pk and on_tpu)
+                else on_tpu)
             try:
                 return make_and_warm(*build(lay))
             except Exception as e:
                 reasons.append(
                     f"{lay}/{cw}{'/prologue' if prol else ''}"
-                    f"{'/prefill-kernel' if pk else ''}: "
+                    f"{'/fused-matmul' if pk == 'fused' else '/prefill-kernel' if pk else ''}: "
                     f"{type(e).__name__}: {e}"[:200])
                 e.__traceback__ = None
                 del e  # drop the exception (and its frame refs) entirely
@@ -3123,7 +3143,7 @@ def main():
         # report the EFFECTIVE kernel engagement: the dequant-matmul gates
         # per-weight (q4_mm_supported), so an A/B record must say how much of
         # the weight bytes actually took the kernel, not what was requested
-        if state["use_pallas"] == "all":
+        if state["use_pallas"] in ("all", "fused"):  # ops/matmul FUSED_POLICIES
             from distributed_llama_tpu.ops.pallas_q4_mm import q4_mm_supported
 
             eng_b = tot_b = 0
@@ -3205,6 +3225,12 @@ def main():
             "achieved_gbps": round(state["wbytes"] / 1e9 / (dt_disp / K), 1),
             "layout": state["layout"], "cache_write": state["cache_write"],
             "attn_window": window or spec.seq_len, "steps": args.steps,
+            # which lowering each traced dispatch shape ACTUALLY took
+            # (ops/matmul.py selection registry) — an A/B record claiming
+            # --fused-matmul must show q4_mm/q4_gated_mm here, not a silent
+            # xla-fallback (docs/SERVING.md "Kernel selection")
+            "kernel_policy": str(state["use_pallas"]),
+            "kernels": sorted(set(kernel_selections().values())),
         }
         if "fallback_reason" in state:
             out["fallback_reason"] = state["fallback_reason"]
@@ -3281,12 +3307,17 @@ def main():
         "attn_window": window or spec.seq_len,
         "device_loop": args.device_loop,
         "steps": args.steps,
-        "fused": not args.no_fuse,
+        # report the EFFECTIVE matvec-group fusion: --fused-matmul keeps the
+        # w1/w3 pair split for the gated-epilogue kernel, so a record saying
+        # "fused" there would claim a merge that never happened
+        "fused": bool(not args.no_fuse and not args.fused_matmul),
         # report the EFFECTIVE prologue state: forward() re-gates it off for
         # non-pallas runs and unsupported dims, and an A/B record claiming a
         # lever that never engaged would corrupt the comparison
         "prologue": bool(state["prologue"] and on_tpu
                          and prologue_supported(spec.dim)),
+        "kernel_policy": str(state["use_pallas"]),
+        "kernels": sorted(set(kernel_selections().values())),
     }
     if "fallback_reason" in state:
         out["fallback_reason"] = state["fallback_reason"]

@@ -115,14 +115,24 @@ class CompileAudit:
             bt = kw.get("kv_block_tokens", 0)
             return f",paged={bt}" if bt else ""
 
+        def _kern(kw):
+            # kernel-policy dimension (ops/matmul.py): the STRING policies
+            # ("all", "fused") change which programs lower — a fused engine's
+            # T buckets are distinct lowerings from the XLA ones and must be
+            # pinned separately. Boolean policies add nothing, so every
+            # pre-existing pinned key is unchanged.
+            up = kw.get("use_pallas")
+            return f",kernel={up}" if isinstance(up, str) else ""
+
         def _static(kw):
             return (f"mode={kw.get('mode', 'greedy')},"
-                    f"window={kw.get('attn_window')}{_paged(kw)}")
+                    f"window={kw.get('attn_window')}{_paged(kw)}{_kern(kw)}")
 
         self._patch_factory(
             engine, "make_sharded_forward",
             lambda spec, mesh, params, **kw:
-                f"forward_step[window={kw.get('attn_window')}{_paged(kw)}]")
+                f"forward_step[window={kw.get('attn_window')}"
+                f"{_paged(kw)}{_kern(kw)}]")
         self._patch_factory(
             device_loop, "make_decode_loop",
             lambda spec, mesh, params, n, **kw:
@@ -142,11 +152,12 @@ class CompileAudit:
 
         self._patch_factory(
             draft_drafter, "make_draft_loop",
-            lambda spec, mesh, params, s, **kw: f"draft_scan[s={s}]")
+            lambda spec, mesh, params, s, **kw:
+                f"draft_scan[s={s}{_kern(kw)}]")
         self._patch_factory(
             draft_drafter, "make_draft_step",
             lambda spec, mesh, params, **kw:
-                f"draft_step[window={kw.get('attn_window')}]")
+                f"draft_step[window={kw.get('attn_window')}{_kern(kw)}]")
         return self
 
     def __exit__(self, *exc) -> None:
@@ -287,6 +298,33 @@ def run_scenario(keep_engine: bool = False):
             rd3.wait(60)
         finally:
             eng2.close()
+        # phase 8 — fused-kernel policy (ops/pallas_q4_mm.py, --fused-matmul):
+        # a THIRD engine with use_pallas upgraded to "fused", so every program
+        # the batched serving path builds under the kernel policy pins under
+        # its own `kernel=fused` key (the string policy is part of the jit
+        # cache key by construction: different lowerings, different programs).
+        # The co-resident self-drafter makes verify engagement deterministic
+        # for ANY prompt (n-gram proposals on a fresh engine are not) and
+        # pins the drafter's own fused draft_scan/draft_step buckets; the
+        # reachable T buckets must stay inside the kernel-off t=2/3/5 set —
+        # a fused key minting a rogue T bucket fails the gate by name.
+        eng3 = BatchEngine(spec, params, slots=2, superstep=4, pipeline=True,
+                           speculative=4, spec_min_draft=1, tp=1,
+                           use_pallas=True, fused_matmul=True,
+                           draft_model=(spec, params))
+        try:
+            rf1 = eng3.submit(p1, 12, Sampler(V))
+            rf2 = eng3.submit(p2, 12, Sampler(V))
+            rf1.wait(60)
+            rf2.wait(60)
+            # seeded stochastic row: sample-mode scan + verify under the
+            # kernel key (the greedy/sample × kernel-on cross)
+            rfs = eng3.submit(p1, 8, Sampler(V, temperature=0.8, seed=7))
+            rfs.wait(60)
+            rfv = eng3.submit(rep, 12, Sampler(V))
+            rfv.wait(60)
+        finally:
+            eng3.close()
         ok = True
     finally:
         # a failed phase must not leak a live engine (scheduler thread +

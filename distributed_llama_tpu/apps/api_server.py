@@ -28,6 +28,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from ..obs import flight, metrics, reqctx, trace
 from ..obs.process import install_process_metrics
+from ..ops import matmul as matmul_ops
 from ..resilience import faults
 from ..resilience.errors import (DeadlineExceeded, EngineClosed,
                                  EngineDraining, EngineSaturated,
@@ -314,6 +315,16 @@ def _stats_payload(state: "ApiState") -> dict:
         out["engine"] = {"pos": eng.pos, "tp": eng.tp, "sp": eng.sp,
                          "paged": eng.paged,
                          "seq_len": eng.spec.seq_len}
+    # kernel-selection provenance (ops/matmul.py registry, docs/SERVING.md
+    # "Kernel selection"): the resolved matmul policy and which lowering each
+    # traced dispatch shape actually took — the human-readable view of
+    # matmul_kernel_selected_total, and the place a silent xla-fallback under
+    # --fused-matmul becomes visible without grepping Prometheus
+    inner = be._eng if be is not None else state.engine
+    if inner is not None:
+        out["kernels"] = {"policy": str(inner.use_pallas),
+                          "fused_matmul": bool(inner.fused_matmul),
+                          "selections": matmul_ops.kernel_selections()}
     return out
 
 
@@ -1440,6 +1451,7 @@ def main(argv=None) -> None:
             tp=args.tp, dp=args.dp, pod=args.pod,
             cache_write=args.cache_write, moe_sharding=args.moe_sharding,
             fused_prologue=args.prologue, prefill_kernel=args.prefill_kernel,
+            fused_matmul=args.fused_matmul,
             dtype=(None if args.dtype == "auto"
                    else jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32),
             use_pallas=False if args.no_pallas else None,

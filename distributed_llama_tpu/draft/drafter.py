@@ -90,7 +90,8 @@ class ModelDrafter:
 
     def __init__(self, spec: ModelSpec, params, *, mesh, slots: int,
                  target_spec: ModelSpec, tokenizer=None, dtype=None,
-                 use_pallas: bool = False, compress_collectives: bool = False,
+                 use_pallas: bool | str = False,
+                 compress_collectives: bool = False,
                  moe_sharding: str = "slice", k_cap: int = 8):
         import jax.numpy as jnp
 
@@ -125,15 +126,19 @@ class ModelDrafter:
         # K-step scan burst between verifies: K+1 pending)
         self.catchup_cap = 2 * self.k_cap + 1
         self.dtype = dtype if dtype is not None else jnp.float32
-        self.use_pallas = bool(use_pallas) and any(
+        # the POLICY passes through unchanged ("fused"/"all" string-valued):
+        # the drafter's k-step scan is the ideal fusion victim — a small
+        # model whose entire weight stream is the per-step cost
+        has_quant = any(
             getattr(t, "ftype", None) in (FloatType.Q40, FloatType.Q80)
             for t in params["blocks"].values())
+        self.use_pallas = use_pallas if has_quant else False
         self.compress = compress_collectives
         self.moe_sharding = moe_sharding if spec.is_moe else "slice"
         if self.use_pallas:
-            params = prepare_for_pallas(params, tp,
-                                        moe_sharding=self.moe_sharding,
-                                        spec=spec)
+            params = prepare_for_pallas(
+                params, tp, moe_sharding=self.moe_sharding, spec=spec,
+                keep_gate_pair=self.use_pallas == "fused")
         self.params = shard_params(params, mesh, spec,
                                    moe_sharding=self.moe_sharding)
         self.rope = RopeTables.create(spec)

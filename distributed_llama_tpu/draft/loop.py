@@ -40,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 from ..models.forward import forward
 from ..models.spec import ModelSpec
 from ..ops.rope import RopeTables
-from ..parallel.mesh import AXIS_SP, AXIS_TP
+from ..parallel.mesh import AXIS_SP
 from ..parallel.sharding import kv_cache_pspec_for_mesh, param_pspecs
 from ..parallel.tp import _expand_pspec_tree
 from ..resilience import faults
@@ -88,7 +88,10 @@ def make_draft_loop(spec: ModelSpec, mesh, params, steps: int, *,
     rope_type = spec.rope_type
     seq_len = spec.seq_len
 
-    fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+    from ..runtime.device_loop import _tp_axis
+
+    fwd = functools.partial(forward, spec=spec, dtype=dtype,
+                            axis_name=_tp_axis(mesh, compress_collectives),
                             sp_axis_name=None, sp_size=1,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives,

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import gqa_attention, update_kv_cache
 from ..ops.kernels import gelu_tanh, rmsnorm, silu
-from ..ops.matmul import qmatmul, qmatmul_q80
+from ..ops.matmul import qmatmul, qmatmul_gated, qmatmul_q80
 from ..ops.ring_attention import (commit_kv_rows_sharded, ring_attention,
                                   update_kv_cache_sharded)
 from ..ops.rope import RopeTables, apply_rope
@@ -67,6 +67,12 @@ def _act(spec: ModelSpec):
     return silu if spec.hidden_act == HiddenAct.SILU else gelu_tanh
 
 
+def _act_name(spec: ModelSpec) -> str:
+    """Static activation name for the fused gate-pair kernel's epilogue
+    (ops/pallas_q4_mm.py matches these formulas in f32)."""
+    return "silu" if spec.hidden_act == HiddenAct.SILU else "gelu_tanh"
+
+
 def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> jax.Array:
     """TP merge point: the reference's gather-partials-and-sum-at-root
     (syncSliceOfSlicedBuffer + merge) becomes an all-reduce over the tp axis.
@@ -82,8 +88,15 @@ def _maybe_psum(x: jax.Array, axis_name: str | None, compress: bool = False) -> 
 def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, start_pos,
                positions, axis_name, sp_axis_name, sp_size, use_pallas, compress,
                window, deferred_write=False, prologue=False, paged_cold=None,
-               block_tables=None, block_tokens=0, paged_kernel=False):
+               block_tables=None, block_tokens=0, paged_kernel=False,
+               residual=None):
     """Sharded attention sub-block against the FULL stacked caches (L, B, hk, S, hs).
+
+    residual: optional (B, T, dim) block input; when given the returned
+    attn_out is ALREADY residual-joined (residual + wo-projection). Under
+    use_pallas == "fused" with a single-chip wo (axis_name None) the add runs
+    inside the dequant-matmul kernel's accumulator; otherwise it is the same
+    `residual + y` the caller used to compute — callers must not re-add.
 
     Head counts in bp may be TP-local slices; the cache sequence axis may be sp-sharded
     (ring attention). The cache WRITE discipline depends on the caller: in-scan mode
@@ -143,8 +156,16 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
             y = qmatmul_q80(aq, asx, bp["wo"], use_pallas=use_pallas,
                             out_dtype=x.dtype)
         else:
+            if (residual is not None and axis_name is None
+                    and use_pallas == "fused"):
+                # single-chip wo: fold the residual into the kernel's f32
+                # accumulator init (TP partials must psum BEFORE the join,
+                # so the fusion is gated to axis_name is None)
+                return qmatmul(att, bp["wo"], use_pallas=use_pallas,
+                               residual=residual)
             y = qmatmul(att, bp["wo"], use_pallas=use_pallas)
-        return _maybe_psum(y, axis_name, compress)
+        y = _maybe_psum(y, axis_name, compress)
+        return y if residual is None else residual + y
     hq_local = q.shape[-1] // hs
     hk_local = k.shape[-1] // hs
     q = apply_rope(q.reshape(b, t, hq_local, hs), rope, positions)
@@ -341,14 +362,21 @@ def _attention(x, bp, layer_idx, spec: ModelSpec, rope: RopeTables, kc, vc, star
 
 
 def _dense_ffn(x, bp, spec: ModelSpec, axis_name, use_pallas, compress,
-               prologue=False):
+               prologue=False, residual=None):
     """Dense FFN on the PRE-norm block input x (the rms_ffn norm is applied
     here so the prologue can fuse it with the activation quantize). One body
     for both modes — only the projection primitive differs: under the prologue
     each activation row is quantized by a fused kernel (ops/pallas_prologue.py)
     and qmatmul_q80 consumes the pre-quantized row; otherwise the matvecs
     quantize internally. TP-local widths are re-checked before each prologue
-    kernel — the forward()-level gate only validated spec.dim."""
+    kernel — the forward()-level gate only validated spec.dim.
+
+    residual: optional (B, T, dim); when given the return value is ALREADY
+    residual + ffn(x) — under use_pallas == "fused" with a single-chip w2
+    the add fuses into the down-projection kernel's accumulator init, and the
+    gate/up pair (when kept separate — Engine fused_matmul skips the w13
+    merge) lowers to ONE silu·mul-epilogue kernel whose (B·T, hidden)
+    intermediates never touch HBM. Callers must not re-add."""
     act = _act(spec)
     if prologue:
         from ..ops.pallas_prologue import (prologue_supported, quantize_q80_row,
@@ -359,23 +387,37 @@ def _dense_ffn(x, bp, spec: ModelSpec, axis_name, use_pallas, compress,
         def project(wname):
             return qmatmul_q80(xq, sx, bp[wname], use_pallas=use_pallas,
                                out_dtype=jnp.float32)
+
+        if "w13" in bp:
+            h = _gated_split(project("w13"), act, gate_first=True)
+        else:
+            h = act(project("w1")) * project("w3")
     else:
         xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
-
-        def project(wname):
-            return qmatmul(xb, bp[wname], use_pallas=use_pallas)
-    if "w13" in bp:
-        # merged gate+up (fuse_matvec_groups): one launch, [w1|w3] per TP group
-        h = _gated_split(project("w13"), act, gate_first=True)
-    else:
-        h = act(project("w1")) * project("w3")
+        if "w13" in bp:
+            # merged gate+up (fuse_matvec_groups): one launch per TP group;
+            # the packed stream is already one pass, only the act·mul epilogue
+            # stays un-fused on this layout
+            h = _gated_split(qmatmul(xb, bp["w13"], use_pallas=use_pallas),
+                             act, gate_first=True)
+        else:
+            h = qmatmul_gated(xb, bp["w1"], bp["w3"], act=act,
+                              act_name=_act_name(spec),
+                              use_pallas=use_pallas)
     if prologue and prologue_supported(h.shape[-1]):
         hq, hsx = quantize_q80_row(h)
         out = qmatmul_q80(hq, hsx, bp["w2"], use_pallas=use_pallas,
                           out_dtype=x.dtype)
     else:
+        if (residual is not None and axis_name is None
+                and use_pallas == "fused"):
+            # single-chip w2: residual folds into the kernel accumulator
+            # (TP partials must psum before the join — see _attention)
+            return qmatmul(h.astype(x.dtype), bp["w2"], use_pallas=use_pallas,
+                           residual=residual)
         out = qmatmul(h.astype(x.dtype), bp["w2"], use_pallas=use_pallas)
-    return _maybe_psum(out, axis_name, compress)
+    out = _maybe_psum(out, axis_name, compress)
+    return out if residual is None else residual + out
 
 
 def _gated_split(y, act, gate_first: bool):
@@ -580,6 +622,11 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
     else:
         x, kc, vc = carry
     bp, layer_idx = layer
+    # grok residual-joins the NORMALIZED attention output, so the projection
+    # kernel cannot fold the raw residual there; every other arch hands the
+    # block input down as the fusable residual (contract: attn_out returns
+    # already joined when residual is given)
+    res_attn = None if spec.arch_type == ArchType.GROK1 else x
     attn_out, kvout = _attention(x, bp, layer_idx, spec, rope, kc, vc, start_pos,
                                  positions, axis_name, sp_axis_name, sp_size,
                                  use_pallas, compress, window,
@@ -587,7 +634,8 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
                                  paged_cold=paged_cold,
                                  block_tables=block_tables,
                                  block_tokens=block_tokens,
-                                 paged_kernel=paged_kernel)
+                                 paged_kernel=paged_kernel,
+                                 residual=res_attn)
     if not deferred:
         kc, vc = kvout
     if spec.arch_type == ArchType.GROK1:
@@ -597,13 +645,13 @@ def _block(carry, layer, spec: ModelSpec, rope: RopeTables, start_pos, positions
         moe_out = _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
         x = x + rmsnorm(moe_out, bp["rms_ffn2"], spec.norm_eps)
     else:
-        x = x + attn_out
+        x = attn_out  # residual-joined inside _attention
         if spec.is_moe:
             xb = rmsnorm(x, bp["rms_ffn"], spec.norm_eps)
             x = x + _moe_ffn(xb, bp, spec, axis_name, use_pallas, compress)
         else:
-            x = x + _dense_ffn(x, bp, spec, axis_name, use_pallas, compress,
-                               prologue=prologue)
+            x = _dense_ffn(x, bp, spec, axis_name, use_pallas, compress,
+                           prologue=prologue, residual=x)
     if deferred:
         return x, kvout  # ys: this layer's (k_t, v_t) new rows
     return (x, kc, vc), None

@@ -163,7 +163,8 @@ _FUSE_ROW_AXIS = {"wqkv": 1, "w13": 1, "moe_gu": 2}
 
 
 def fuse_matvec_groups(blocks: Params, spec: ModelSpec | None, tp: int,
-                       moe_sharding: str = "slice") -> Params:
+                       moe_sharding: str = "slice",
+                       skip: tuple[str, ...] = ()) -> Params:
     """Replace wq/wk/wv -> wqkv, w1/w3 -> w13, moe_up/moe_gate -> moe_gu with
     row-concatenated (TP-group interleaved) planar tensors where safe. Skipped
     per group when a member is not kernel-convertible or (QKV) when KV-head
@@ -174,6 +175,8 @@ def fuse_matvec_groups(blocks: Params, spec: ModelSpec | None, tp: int,
 
     out = dict(blocks)
     for fused, members in _FUSE_GROUPS.items():
+        if fused in skip:
+            continue
         ts = [blocks.get(m) for m in members]
         if not all(isinstance(t, QTensor) and t.layout == "planar"
                    and _kernel_convertible(t, stacked=True) for t in ts):
@@ -200,7 +203,8 @@ def fuse_matvec_groups(blocks: Params, spec: ModelSpec | None, tp: int,
 def prepare_for_pallas(params: Params, tp: int = 1,
                        moe_sharding: str = "slice",
                        spec: ModelSpec | None = None,
-                       fuse: bool = True) -> Params:
+                       fuse: bool = True,
+                       keep_gate_pair: bool = False) -> Params:
     """Repack the dense matmul weights into the Pallas decode-kernel layouts
     (i4p packed nibbles for Q40, int8 planes for Q80). Row/col TP slices stay
     32-block-aligned; col-sharded tensors are packed per TP column group so each
@@ -209,15 +213,19 @@ def prepare_for_pallas(params: Params, tp: int = 1,
 
     fuse=True additionally merges the QKV and gate/up matvec groups into single
     row-concatenated tensors (fuse_matvec_groups) so decode launches one kernel
-    per group instead of one per tensor."""
+    per group instead of one per tensor. keep_gate_pair=True exempts w1/w3
+    from that merge: the batched gate-pair kernel (ops/pallas_q4_mm.py
+    q4_gated_matmul, Engine fused_matmul) fuses the silu·mul epilogue across
+    the SEPARATE pair, which beats the merged-launch win for M>1."""
     import os
 
     out: Params = {"embedding": params["embedding"], "blocks": {},
                    "rms_final": params["rms_final"]}
     fuse = fuse and not os.environ.get("DLT_NO_FUSE")  # field kill-switch
     blocks = (fuse_matvec_groups(params["blocks"], spec, tp,
-                                 moe_sharding=moe_sharding) if fuse
-              else params["blocks"])
+                                 moe_sharding=moe_sharding,
+                                 skip=("w13",) if keep_gate_pair else ())
+              if fuse else params["blocks"])
     for name, t in blocks.items():
         if ((name in _DENSE_MATMULS or name in _FUSE_GROUPS)
                 and _kernel_convertible(t, stacked=True)):

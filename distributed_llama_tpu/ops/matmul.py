@@ -18,45 +18,157 @@ Weights keep the reference's (out, in) row-major orientation with quant blocks a
 from __future__ import annotations
 
 import math
+import threading
 
 import jax
 import jax.numpy as jnp
 
+from ..obs import metrics
 from ..quants import QTensor
+from ..resilience import faults
+
+# "fused" is a strict superset of "all": everything "all" lowers plus the
+# residual-add / silu·mul epilogue fusions wired through models/forward.py
+FUSED_POLICIES = ("all", "fused")
+
+_KERNEL_SELECTED = metrics.counter(
+    "matmul_kernel_selected_total",
+    "matmul kernel lowerings by selected kernel (counted at trace time: one "
+    "per compiled program per call site, not per dispatch)",
+    labelnames=("kernel",))
+
+# trace-time record of which kernel served each (M, N, K, layout) bucket —
+# the per-shape truth behind bench.py's provenance fields and /v1/stats'
+# kernel block. Keys are dispatch-shape buckets (bounded: one per distinct
+# lowered matmul shape), values are kernel names.
+_selections: dict[str, str] = {}
+_selections_lock = threading.Lock()
+
+
+def _record(kernel: str, m: int, w: QTensor, op: str = "mm") -> None:
+    n, kin = w.shape
+    key = f"m={m},n={n},k={kin},layout={w.layout},op={op}"
+    with _selections_lock:
+        if _selections.get(key) != kernel:
+            _selections[key] = kernel
+            _KERNEL_SELECTED.labels(kernel=kernel).inc()
+
+
+def kernel_selections() -> dict[str, str]:
+    """Snapshot of {shape-bucket: kernel} selections recorded at trace time
+    (bench.py provenance + /v1/stats). Kernel names: q4_matvec, q8_matvec,
+    q4_mm, q4_mm+res, q4_gated_mm, xla, xla-fallback."""
+    with _selections_lock:
+        return dict(_selections)
+
+
+def reset_kernel_selections() -> None:
+    """Tests/bench only: drop the recorded selection map."""
+    with _selections_lock:
+        _selections.clear()
 
 
 def qmatmul(x: jax.Array, w: QTensor, *, use_pallas: bool | str = False,
-            out_dtype=None) -> jax.Array:
+            out_dtype=None, residual: jax.Array | None = None) -> jax.Array:
     """y = x @ W^T for W of logical shape (out, in); x: (..., in) -> (..., out).
 
     use_pallas: False = XLA everywhere; True = fused kernels for decode (one
     activation row); "all" = additionally the fused dequant-matmul for M>1
-    (prefill / batched decode — ops/pallas_q4_mm.py, opt-in until the hardware
-    A/B lands)."""
+    (prefill / batched decode — ops/pallas_q4_mm.py); "fused" = "all" plus the
+    fused epilogues (--fused-matmul / DLT_FUSED_MATMUL).
+
+    residual: optional (..., out) tensor; the result is residual + x @ W^T on
+    EVERY path (under "fused" the add runs inside the kernel's accumulator;
+    the fallbacks add in f32 before the out_dtype cast — same rounding as one
+    fused f32 accumulate, so a shape-gated fallback stays token-identical)."""
     m = math.prod(x.shape[:-1])
     if use_pallas and m == 1:
         if w.layout == "i4p":
             from .pallas_q4 import q4_decode_supported, q4_matvec
 
             if w.groups == 1 and q4_decode_supported(w):
-                return q4_matvec(x, w, out_dtype=out_dtype or x.dtype)
+                _record("q4_matvec", m, w)
+                y = q4_matvec(x, w, out_dtype=out_dtype or x.dtype)
+                return y if residual is None else _res_add(y, residual,
+                                                           out_dtype or x.dtype)
         else:
             from .pallas_q8 import q8_decode_supported, q8_matvec
 
             if q8_decode_supported(w):
-                return q8_matvec(x, w, out_dtype=out_dtype or x.dtype)
-    if use_pallas == "all" and m > 1 and w.layout == "i4p":
+                _record("q8_matvec", m, w)
+                y = q8_matvec(x, w, out_dtype=out_dtype or x.dtype)
+                return y if residual is None else _res_add(y, residual,
+                                                           out_dtype or x.dtype)
+    if use_pallas in FUSED_POLICIES and m > 1 and w.layout == "i4p":
         from .pallas_q4_mm import q4_matmul, q4_mm_supported
 
-        if q4_mm_supported(w, m):
-            return q4_matmul(x, w, out_dtype=out_dtype or x.dtype)
+        try:
+            # fires BEFORE the shape gate so the fault-matrix cells are
+            # non-vacuous on any fused engine; any raise degrades to XLA
+            faults.fire("matmul.kernel_select", m=m, n=w.shape[0])
+            if q4_mm_supported(w, m):
+                fuse_res = residual is not None and use_pallas == "fused"
+                y = q4_matmul(x, w, out_dtype=out_dtype or x.dtype,
+                              residual=residual if fuse_res else None)
+                _record("q4_mm+res" if fuse_res else "q4_mm", m, w)
+                if residual is not None and not fuse_res:
+                    return _res_add(y, residual, out_dtype or x.dtype)
+                return y
+        except Exception:  # noqa: BLE001 — any kernel-path failure -> XLA
+            _record("xla-fallback", m, w)
+            return _qmatmul_xla(x, w, out_dtype=out_dtype, residual=residual)
+    _record("xla", m, w)
+    return _qmatmul_xla(x, w, out_dtype=out_dtype, residual=residual)
+
+
+def _res_add(y: jax.Array, residual: jax.Array, out_dtype) -> jax.Array:
+    return (residual.astype(jnp.float32)
+            + y.astype(jnp.float32)).astype(out_dtype)
+
+
+def _qmatmul_xla(x: jax.Array, w: QTensor, *, out_dtype=None,
+                 residual: jax.Array | None = None) -> jax.Array:
+    """The oracle path: dequantize + dot_general; XLA fuses the scale
+    broadcast into the operand pipeline. Residual adds in f32 before the
+    cast (identical rounding to the kernel's f32 accumulator-init)."""
     wd = w.dequantize(dtype=x.dtype)
     y = jax.lax.dot_general(
         x, wd,
         dimension_numbers=(((x.ndim - 1,), (wd.ndim - 1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if residual is not None:
+        y = residual.astype(jnp.float32) + y
     return y.astype(out_dtype or x.dtype)
+
+
+def qmatmul_gated(x: jax.Array, w1: QTensor, w3: QTensor, *, act,
+                  act_name: str, use_pallas: bool | str = False,
+                  out_dtype=None) -> jax.Array:
+    """FFN gate-pair: act(x @ w1^T) * (x @ w3^T). Under use_pallas == "fused"
+    with M>1 and a kernel-eligible i4p pair this lowers to ONE
+    q4_gated_matmul (both weight streams at packed density, intermediates
+    VMEM-only); every other configuration runs two qmatmul calls + the jnp
+    activation (`act`, matching the kernel's `act_name` epilogue)."""
+    m = math.prod(x.shape[:-1])
+    if (use_pallas == "fused" and m > 1
+            and w1.layout == "i4p" and w3.layout == "i4p"
+            and act_name in ("silu", "gelu_tanh")):
+        from .pallas_q4_mm import q4_gated_matmul, q4_gated_supported
+
+        try:
+            faults.fire("matmul.kernel_select", m=m, n=w1.shape[0])
+            if q4_gated_supported(w1, w3, m):
+                y = q4_gated_matmul(x, w1, w3, act=act_name,
+                                    out_dtype=out_dtype or x.dtype)
+                _record("q4_gated_mm", m, w1, op="gated")
+                return y
+        except Exception:  # noqa: BLE001 — any kernel-path failure -> XLA
+            _record("xla-fallback", m, w1, op="gated")
+            return (act(_qmatmul_xla(x, w1, out_dtype=out_dtype))
+                    * _qmatmul_xla(x, w3, out_dtype=out_dtype))
+    return (act(qmatmul(x, w1, use_pallas=use_pallas, out_dtype=out_dtype))
+            * qmatmul(x, w3, use_pallas=use_pallas, out_dtype=out_dtype))
 
 
 def qmatmul_q80(xq: jax.Array, sx: jax.Array, w: QTensor, *,

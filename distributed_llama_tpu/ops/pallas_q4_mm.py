@@ -23,7 +23,14 @@ integer-exact _f16_bits_to_f32, and the dot is bf16xbf16->f32 on the MXU. No
 f16 refs anywhere.
 
 Opt-in (Engine prefill_kernel / DLT_PREFILL_KERNEL, bench --prefill-kernel)
-until a hardware A/B lands — same policy as the prologue kernels.
+until a hardware A/B lands — same policy as the prologue kernels. The batched
+serving runtime opts in one level higher (Engine fused_matmul /
+DLT_FUSED_MATMUL, --fused-matmul): the same kernel family with the legal
+epilogues fused — residual add in the accumulator init (q4_matmul residual=)
+and the silu·mul FFN gate pair as one kernel over the separate w1/w3 planes
+(q4_gated_matmul) — serving decode M=B, verify M=B·(1+k), and drafter rows
+(docs/SERVING.md "Kernel selection"; byte model in perf/PROFILE.md "Batched
+fused Q40 cost model", measured by perf/q4_mm_bench.py).
 """
 
 from __future__ import annotations
@@ -39,13 +46,11 @@ from ..quants import QK, QTensor
 from .pallas_q4 import _f16_bits_to_f32
 
 
-def _mm_kernel(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref, o_ref, *, bn, bkp):
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _init():
-        o_ref[:] = jnp.zeros_like(o_ref)
-
+# hot-path: traced
+def _tile_partial(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref, *, bn, bkp):
+    """One grid step's (M, bn) partial product: decode the packed (bn, bkp)
+    nibble tile + both scale views in VMEM and hit the MXU twice (low-plane
+    and high-plane K-ranges of the split-plane layout)."""
     wp = wp_ref[:]  # (bn, bkp) uint8 packed columns
     lo = (wp & jnp.uint8(0x0F)).astype(jnp.int32)  # elements [c, c+bkp)
     hi = wp.astype(jnp.int32) >> 4  # elements [K/2+c, K/2+c+bkp)
@@ -64,7 +69,67 @@ def _mm_kernel(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref, o_ref, *, bn, bkp):
     acc += jax.lax.dot_general(
         xhi_ref[:].astype(jnp.bfloat16), w_hi, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    o_ref[:] += acc
+    return acc
+
+
+# hot-path: traced
+def _act_f32(a, act: str):
+    """Epilogue activation on the f32 accumulator, formulas matching
+    ops/kernels.py bit-for-bit in f32 (silu / tanh-approx GELU)."""
+    if act == "silu":
+        return a / (1.0 + jnp.exp(-a))
+    c = 0.79788456080286535587989211986876  # sqrt(2/pi), as gelu_tanh
+    return 0.5 * a * (1.0 + jnp.tanh(c * a * (1.0 + 0.044715 * a * a)))
+
+
+def _mm_kernel(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref, o_ref, *, bn, bkp):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += _tile_partial(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref,
+                              bn=bn, bkp=bkp)
+
+
+def _mm_res_kernel(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref, res_ref, o_ref,
+                   *, bn, bkp):
+    """Residual-fused variant: the accumulator STARTS at the residual block
+    (same (M, bn) tile the output covers), so `res + x @ w.T` costs zero extra
+    HBM round-trips — the residual streams in once with the output tile."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[:] = res_ref[:].astype(jnp.float32)
+
+    o_ref[:] += _tile_partial(xlo_ref, xhi_ref, wp_ref, slo_ref, shi_ref,
+                              bn=bn, bkp=bkp)
+
+
+def _gated_mm_kernel(xlo_ref, xhi_ref, w1p_ref, s1lo_ref, s1hi_ref,
+                     w3p_ref, s3lo_ref, s3hi_ref, o_ref, acc1_ref, acc3_ref,
+                     *, bn, bkp, gk, act):
+    """FFN gate-pair fusion: act(x @ w1.T) * (x @ w3.T) in ONE kernel. Both
+    accumulators live in VMEM scratch across the sequential K grid; the
+    silu/gelu·mul epilogue runs on the last K step, so the (M, hidden)
+    intermediate activations never exist in HBM at all."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc1_ref[:] = jnp.zeros_like(acc1_ref)
+        acc3_ref[:] = jnp.zeros_like(acc3_ref)
+
+    acc1_ref[:] += _tile_partial(xlo_ref, xhi_ref, w1p_ref, s1lo_ref, s1hi_ref,
+                                 bn=bn, bkp=bkp)
+    acc3_ref[:] += _tile_partial(xlo_ref, xhi_ref, w3p_ref, s3lo_ref, s3hi_ref,
+                                 bn=bn, bkp=bkp)
+
+    @pl.when(j == gk - 1)
+    def _epilogue():
+        o_ref[:] = _act_f32(acc1_ref[:], act) * acc3_ref[:]
 
 
 _BN = 256  # weight rows per grid step
@@ -91,10 +156,9 @@ def q4_mm_supported(w: QTensor, m: int) -> bool:
     return _pick_bkp(kh) is not None and m <= 512
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _q4_matmul(x, wp, scales, *, interpret: bool = False):
-    """x (M, K) -> (M, N) against packed nibbles (N, K/2) + int16 f16-bit scales
-    (N, K/32)."""
+def _grid_geom(x, wp, scales):
+    """(bn, bkp, gk, sb) for one (M, K) x (N, K/2) dispatch, asserting the
+    split-plane shapes line up."""
     m, k = x.shape
     n, kh = wp.shape
     nb = k // QK
@@ -103,24 +167,40 @@ def _q4_matmul(x, wp, scales, *, interpret: bool = False):
     bkp = _pick_bkp(kh)
     assert bkp is not None, (kh, "half-plane not tileable; gate with "
                                  "q4_mm_supported")
-    bn = min(_BN, n)
-    gk = kh // bkp
-    sb = bkp // QK  # scale columns per tile
+    return min(_BN, n), bkp, kh // bkp, bkp // QK
+
+
+def _x_specs(m, bkp, gk):
+    # two views of x: the tile's low-plane and high-plane K-ranges
+    return [
+        pl.BlockSpec((m, bkp), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        pl.BlockSpec((m, bkp), lambda i, j: (0, j + gk),
+                     memory_space=pltpu.VMEM),
+    ]
+
+
+def _w_specs(bn, bkp, sb, gk):
+    # one packed-nibble tile + its low/high scale views
+    return [
+        pl.BlockSpec((bn, bkp), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        pl.BlockSpec((bn, sb), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        pl.BlockSpec((bn, sb), lambda i, j: (i, j + gk),
+                     memory_space=pltpu.VMEM),
+    ]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q4_matmul(x, wp, scales, *, interpret: bool = False):
+    """x (M, K) -> (M, N) against packed nibbles (N, K/2) + int16 f16-bit scales
+    (N, K/32)."""
+    m = x.shape[0]
+    n = wp.shape[0]
+    bn, bkp, gk, sb = _grid_geom(x, wp, scales)
     kernel = functools.partial(_mm_kernel, bn=bn, bkp=bkp)
     return pl.pallas_call(
         kernel,
         grid=(pl.cdiv(n, bn), gk),
-        in_specs=[
-            # two views of x: the tile's low-plane and high-plane K-ranges
-            pl.BlockSpec((m, bkp), lambda i, j: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((m, bkp), lambda i, j: (0, j + gk),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn, bkp), lambda i, j: (i, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn, sb), lambda i, j: (i, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((bn, sb), lambda i, j: (i, j + gk),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=_x_specs(m, bkp, gk) + _w_specs(bn, bkp, sb, gk),
         out_specs=pl.BlockSpec((m, bn), lambda i, j: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
@@ -128,13 +208,69 @@ def _q4_matmul(x, wp, scales, *, interpret: bool = False):
     )(x, x, wp, scales, scales)
 
 
-def q4_matmul(x: jax.Array, w: QTensor, *, out_dtype=None,
-              interpret: bool | None = None) -> jax.Array:
-    """Prefill/batched matmul: x (..., K) against an i4p QTensor (N, K) ->
-    (..., N), weights streamed once at 4-bit density."""
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _q4_matmul_res(x, wp, scales, res, *, interpret: bool = False):
+    """x (M, K), res (M, N) -> res + x @ dequant(w).T, residual folded into
+    the accumulator init (one extra streamed operand, no epilogue pass)."""
+    m = x.shape[0]
+    n = wp.shape[0]
+    assert res.shape == (m, n), (res.shape, (m, n))
+    bn, bkp, gk, sb = _grid_geom(x, wp, scales)
+    kernel = functools.partial(_mm_res_kernel, bn=bn, bkp=bkp)
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(n, bn), gk),
+        in_specs=(_x_specs(m, bkp, gk) + _w_specs(bn, bkp, sb, gk) + [
+            pl.BlockSpec((m, bn), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+        ]),
+        out_specs=pl.BlockSpec((m, bn), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=interpret,
+    )(x, x, wp, scales, scales, res)
+
+
+@functools.partial(jax.jit, static_argnames=("act", "interpret"))
+def _q4_gated_matmul(x, w1p, s1, w3p, s3, *, act: str,
+                     interpret: bool = False):
+    """act(x @ w1.T) * (x @ w3.T) with both (M, N) accumulators in VMEM
+    scratch — the FFN pair's intermediate activations never touch HBM."""
+    m = x.shape[0]
+    n = w1p.shape[0]
+    assert w3p.shape == w1p.shape and s3.shape == s1.shape, (
+        w1p.shape, w3p.shape, s1.shape, s3.shape)
+    bn, bkp, gk, sb = _grid_geom(x, w1p, s1)
+    kernel = functools.partial(_gated_mm_kernel, bn=bn, bkp=bkp, gk=gk,
+                               act=act)
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(n, bn), gk),
+        in_specs=(_x_specs(m, bkp, gk) + _w_specs(bn, bkp, sb, gk)
+                  + _w_specs(bn, bkp, sb, gk)),
+        out_specs=pl.BlockSpec((m, bn), lambda i, j: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32),
+                        pltpu.VMEM((m, bn), jnp.float32)],
+        interpret=interpret,
+    )(x, x, w1p, s1, s1, w3p, s3, s3)
+
+
+def _flatten_rows(x):
     m_total = 1
     for d in x.shape[:-1]:
         m_total *= d
+    return m_total, x.shape[:-1]
+
+
+def q4_matmul(x: jax.Array, w: QTensor, *, out_dtype=None,
+              interpret: bool | None = None,
+              residual: jax.Array | None = None) -> jax.Array:
+    """Prefill/batched matmul: x (..., K) against an i4p QTensor (N, K) ->
+    (..., N), weights streamed once at 4-bit density. With `residual`
+    (shape (..., N)) the add is fused into the accumulator init."""
+    m_total, lead = _flatten_rows(x)
     if not q4_mm_supported(w, m_total):
         raise ValueError(
             f"q4_matmul cannot run this weight (layout={w.layout}, "
@@ -142,7 +278,45 @@ def q4_matmul(x: jax.Array, w: QTensor, *, out_dtype=None,
             f"M={m_total}); gate with q4_mm_supported")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    lead = x.shape[:-1]
     k = x.shape[-1]
-    y = _q4_matmul(x.reshape(m_total, k), w.data, w.scales, interpret=interpret)
+    if residual is None:
+        y = _q4_matmul(x.reshape(m_total, k), w.data, w.scales,
+                       interpret=interpret)
+    else:
+        y = _q4_matmul_res(x.reshape(m_total, k), w.data, w.scales,
+                           residual.reshape(m_total, residual.shape[-1]),
+                           interpret=interpret)
+    return y.reshape(*lead, y.shape[-1]).astype(out_dtype or x.dtype)
+
+
+def q4_gated_supported(w1: QTensor, w3: QTensor, m: int) -> bool:
+    """Whether the fused FFN gate-pair kernel can serve act(x@w1.T) * (x@w3.T):
+    both weights individually kernel-eligible and shape-identical (they tile
+    on one grid), plus VMEM headroom for the two (M, bn) scratch
+    accumulators."""
+    return (q4_mm_supported(w1, m) and q4_mm_supported(w3, m)
+            and w1.data.shape == w3.data.shape
+            and w1.scales.shape == w3.scales.shape)
+
+
+def q4_gated_matmul(x: jax.Array, w1: QTensor, w3: QTensor, *,
+                    act: str = "silu", out_dtype=None,
+                    interpret: bool | None = None) -> jax.Array:
+    """FFN gate-pair: act(x @ w1.T) * (x @ w3.T) for x (..., K) against two
+    i4p QTensors (N, K), one fused kernel — both weight streams at 4-bit
+    density and ZERO HBM traffic for the (..., N) intermediates."""
+    m_total, lead = _flatten_rows(x)
+    if not q4_gated_supported(w1, w3, m_total):
+        raise ValueError(
+            f"q4_gated_matmul cannot run this pair (layouts={w1.layout}/"
+            f"{w3.layout}, shapes={getattr(w1.data, 'shape', None)}/"
+            f"{getattr(w3.data, 'shape', None)}, M={m_total}); gate with "
+            f"q4_gated_supported")
+    if act not in ("silu", "gelu_tanh"):
+        raise ValueError(f"unsupported epilogue activation {act!r}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k = x.shape[-1]
+    y = _q4_gated_matmul(x.reshape(m_total, k), w1.data, w1.scales,
+                         w3.data, w3.scales, act=act, interpret=interpret)
     return y.reshape(*lead, y.shape[-1]).astype(out_dtype or x.dtype)

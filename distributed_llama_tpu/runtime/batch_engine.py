@@ -575,7 +575,7 @@ class BatchEngine:
                         dspec, dparams, mesh=self._eng.mesh, slots=slots,
                         target_spec=spec, tokenizer=tokenizer,
                         dtype=self._eng.dtype,
-                        use_pallas=bool(self._eng.use_pallas),
+                        use_pallas=self._eng.use_pallas,
                         compress_collectives=self._eng.compress,
                         moe_sharding=self._eng.moe_sharding, k_cap=dk)
                 else:
@@ -583,7 +583,7 @@ class BatchEngine:
                         str(draft_model), mesh=self._eng.mesh, slots=slots,
                         target_spec=spec, tokenizer=tokenizer,
                         dtype=self._eng.dtype,
-                        use_pallas=bool(self._eng.use_pallas),
+                        use_pallas=self._eng.use_pallas,
                         compress_collectives=self._eng.compress,
                         moe_sharding=self._eng.moe_sharding, k_cap=dk)
             except Exception as e:

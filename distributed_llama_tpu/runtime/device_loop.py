@@ -52,6 +52,16 @@ from ..parallel.sharding import kv_cache_pspec_for_mesh, param_pspecs
 from ..parallel.tp import _expand_pspec_tree
 
 
+def _tp_axis(mesh, compress_collectives: bool) -> str | None:
+    """AXIS_TP, or None when the tp axis has one member: a 1-member axis has
+    nothing to reduce, so dropping the name elides every psum/all_gather AND
+    lets the "fused" matmul policy fold residual adds into the kernels
+    (illegal before a real TP merge). Compressed collectives keep the axis —
+    their Q80 wire quantization is part of the numerics even over one
+    member."""
+    return AXIS_TP if (mesh.shape[AXIS_TP] > 1 or compress_collectives) else None
+
+
 def device_sample_coin(logits: jax.Array, u: jax.Array, temperature: jax.Array,
                        topp: jax.Array) -> jax.Array:
     """Sample one token id from a (vocab,) f32 logits row, reference semantics.
@@ -180,7 +190,8 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     kv_spec = kv_cache_pspec_for_mesh(mesh)
     rope_type = spec.rope_type
 
-    fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+    fwd = functools.partial(forward, spec=spec, dtype=dtype,
+                            axis_name=_tp_axis(mesh, compress_collectives),
                             sp_axis_name=AXIS_SP if sp > 1 else None, sp_size=sp,
                             use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
@@ -297,7 +308,8 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
     rope_type = spec.rope_type
     seq_len = spec.seq_len
 
-    fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+    fwd = functools.partial(forward, spec=spec, dtype=dtype,
+                            axis_name=_tp_axis(mesh, compress_collectives),
                             sp_axis_name=None, sp_size=1, use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
                             attn_window=attn_window, cache_write=cache_write,
@@ -432,7 +444,8 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
                else kv_cache_pspec_for_mesh(mesh))
     rope_type = spec.rope_type
 
-    fwd = functools.partial(forward, spec=spec, dtype=dtype, axis_name=AXIS_TP,
+    fwd = functools.partial(forward, spec=spec, dtype=dtype,
+                            axis_name=_tp_axis(mesh, compress_collectives),
                             sp_axis_name=None, sp_size=1, use_pallas=use_pallas,
                             compress_collectives=compress_collectives,
                             attn_window=attn_window, cache_write=cache_write,

@@ -130,6 +130,18 @@ DRAFT_POINTS = ("draft.load", "draft.propose", "draft.dispatch")
 DRAFT_KINDS = ("error", "latency")
 DRAFT_CELLS = len(DRAFT_POINTS) * len(DRAFT_KINDS) * 2  # × {pipe, serial}
 
+# Fused-kernel family (ISSUE 16, docs/SERVING.md "Kernel selection"): the
+# `matmul.kernel_select` point fires at TRACE time inside the fused matmul
+# dispatch (ops/matmul.py), BEFORE the shape gate — a raising kernel path
+# must degrade that call site to the XLA lowering (bit-identical by the
+# oracle contract) without killing co-batched rows or the engine. Cells
+# build a FRESH --fused-matmul engine UNDER injection, so kernel selection
+# actually happens while the fault is armed: every output must equal the
+# kernel-off reference byte-for-byte whether the kernel path served or
+# degraded, and fs.fired is asserted > 0 (non-vacuous).
+FUSED_POINT = "matmul.kernel_select"
+FUSED_CELLS = len(KINDS) * 2  # × {pipelined, serialized}
+
 
 def _spec(seq_len=128):
     return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
@@ -360,6 +372,96 @@ def run_draft_family() -> tuple[int, list[str]]:
         for kind in DRAFT_KINDS:
             cells += 1
             problems += run_draft_load_cell(pipeline, kind, refs, tag)
+    return cells, problems
+
+
+def build_fused_engine(pipeline: bool):
+    """A --fused-matmul batched engine (use_pallas upgraded to "fused",
+    ops/matmul.py): every M>1 matmul the programs trace runs the kernel
+    dispatch, so `matmul.kernel_select` fires while the cell's fault is
+    armed and the except-path degrades that call site to XLA."""
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    return spec, BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                             pipeline=pipeline, speculative=4,
+                             use_pallas=True, fused_matmul=True)
+
+
+def run_fused_cell(pipeline: bool, kind: str, refs: dict) -> list[str]:
+    """One fused-kernel cell: construct the engine (and trace its first
+    programs — where kernel selection happens) UNDER injection. A failing
+    kernel path is a TRACE-time event: it must cost only the kernel (that
+    call site lowers via XLA), never a request — every output must equal
+    the kernel-off reference byte-for-byte, co-batched rows included."""
+    problems: list[str] = []
+    tag = "fused-pipelined" if pipeline else "fused-serialized"
+    name = f"[{tag}] {FUSED_POINT}/{kind}"
+    fs = FaultSpec(FUSED_POINT, kind=kind, count=4, delay_ms=10)
+    be = None
+    try:
+        with faults.active(fs):
+            spec, be = build_fused_engine(pipeline)
+            reqs = [(p, be.submit(list(p), SPEC_GEN, _greedy(spec)))
+                    for p in SPEC_PROMPTS]
+            for p, r in reqs:
+                try:
+                    out = r.wait(timeout=120)
+                except Exception as e:
+                    problems.append(f"{name}: client-visible failure {e!r}")
+                    continue
+                if r.error is not None:
+                    problems.append(f"{name}: request errored {r.error!r}")
+                elif out != refs[tuple(p)]:
+                    problems.append(f"{name}: output diverged from the "
+                                    "kernel-off reference "
+                                    f"({out[:6]}... vs "
+                                    f"{refs[tuple(p)][:6]}...)")
+        faults.uninstall()
+        if fs.fired == 0:
+            problems.append(f"{name}: fault never reached kernel selection "
+                            "(vacuous cell)")
+        if not be.scheduler_alive():
+            problems.append(f"{name}: scheduler thread DIED")
+            return problems
+        try:
+            probe = be.submit(list(SPEC_PROMPTS[0]), SPEC_GEN, _greedy(spec))
+            out = probe.wait(timeout=120)
+            if out != refs[tuple(SPEC_PROMPTS[0])] or probe.error is not None:
+                problems.append(f"{name}: probe degraded "
+                                f"({len(out)} tokens, err={probe.error!r})")
+        except Exception as e:
+            problems.append(f"{name}: probe failed: {e!r}")
+        with be._plock:
+            leaked = [s for s in be._slots
+                      if s.req is not None or s.lease is not None]
+        if leaked:
+            problems.append(f"{name}: slot/lease leak")
+    finally:
+        faults.uninstall()
+        if be is not None:
+            be.close()
+    return problems
+
+
+def run_fused_family() -> tuple[int, list[str]]:
+    cells = 0
+    problems: list[str] = []
+    # kernel-off reference (the XLA oracle, use_pallas=False): fused cells
+    # must emit exactly these tokens whether the kernel path served or
+    # degraded mid-trace
+    spec, be = build_batch_engine(pipeline=True, speculative=4)
+    try:
+        refs = {tuple(p): be.submit(list(p), SPEC_GEN,
+                                    _greedy(spec)).wait(timeout=120)
+                for p in SPEC_PROMPTS}
+    finally:
+        be.close()
+    for pipeline in (True, False):
+        for kind in KINDS:
+            cells += 1
+            problems += run_fused_cell(pipeline, kind, refs)
     return cells, problems
 
 
@@ -1411,6 +1513,12 @@ def run_matrix(include_paged: bool = True,
     d_cells, d_problems = run_draft_family()
     cells += d_cells
     problems += d_problems
+    # fused dequant-matmul kernels: a failing kernel path degrades that
+    # call site to the XLA lowering, token-identical, engine intact
+    # (ISSUE 16, docs/SERVING.md "Kernel selection")
+    k_cells, k_problems = run_fused_family()
+    cells += k_cells
+    problems += k_problems
     return cells, problems
 
 

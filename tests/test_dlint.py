@@ -102,6 +102,34 @@ def test_compile_manifest_gate_holds_and_catches_injection():
     assert all(f.rule == "compile-manifest" for f in findings)
 
 
+def test_compile_manifest_names_rogue_fused_bucket():
+    """ISSUE 16 satellite: the kernel policy is part of the program cache
+    key — a verify T bucket minted under the fused policy outside the
+    pinned set must fail the gate BY NAME (kernel=fused in the key), never
+    alias onto the kernel-off pin. The factory call alone records the
+    build (jit traces lazily), so the test costs no compile."""
+    from distributed_llama_tpu.analysis import compile_audit
+    from distributed_llama_tpu.models.params import init_random_params
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.quants import FloatType
+    from distributed_llama_tpu.runtime import device_loop
+
+    pinned = compile_audit.load_manifest()
+    assert pinned is not None
+    spec = compile_audit.scenario_spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    audit = compile_audit.CompileAudit()
+    with audit:
+        device_loop.make_batched_verify_loop(
+            spec, make_mesh(tp=1), params, 9, mode="greedy",
+            attn_window=None, use_pallas="fused", kv_block_tokens=16)
+    findings = compile_audit.diff_manifest(audit.manifest(), pinned)
+    assert findings, "gate missed the rogue fused T bucket"
+    key = "verify[t=9,mode=greedy,window=None,paged=16,kernel=fused]"
+    assert any(key in f.message for f in findings), \
+        [f.message for f in findings]
+
+
 def test_compile_manifest_catches_block_table_shape_creep():
     """ISSUE 12 satellite: block-table shapes must be padded/bucketed so
     per-request table growth never mints a fresh XLA lowering. Inject a

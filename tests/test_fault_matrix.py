@@ -26,7 +26,8 @@ def test_fault_matrix_no_scheduler_death_or_slot_leak():
                 + len(fault_matrix.ROUTER_POINTS)) * len(fault_matrix.KINDS) \
         + fault_matrix.SUPERVISOR_CELLS + fault_matrix.DURABILITY_CELLS \
         + fault_matrix.FAIRNESS_CELLS + fault_matrix.DISAGG_CELLS \
-        + fault_matrix.GRAY_CELLS + fault_matrix.DRAFT_CELLS
+        + fault_matrix.GRAY_CELLS + fault_matrix.DRAFT_CELLS \
+        + fault_matrix.FUSED_CELLS
     assert cells == expected, (cells, expected)
     assert not problems, "\n".join(problems)
 
@@ -40,7 +41,8 @@ def test_matrix_covers_documented_inventory():
                   + fault_matrix.PAGED_POINTS + fault_matrix.ROUTER_POINTS
                   + fault_matrix.DISAGG_POINTS
                   + fault_matrix.DISAGG_PLAN_POINTS
-                  + fault_matrix.DRAFT_POINTS)
+                  + fault_matrix.DRAFT_POINTS
+                  + (fault_matrix.FUSED_POINT,))
     doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
                             "ROBUSTNESS.md")).read()
     for point in covered:

@@ -1,0 +1,210 @@
+"""Fused-epilogue Q40 kernels in the batched serving runtime (ISSUE 16).
+
+Three layers of assurance, all interpret-mode on CPU:
+
+- unit: the residual-add and gated silu·mul kernel epilogues against the
+  dequantize-then-compute reference (ops/pallas_q4_mm.py);
+- analytic: the per-dispatch HBM byte model stays within packed-weight
+  density at every serving bucket, and the kernels are consistent with the
+  XLA oracle — greedy argmax identity included (perf/q4_mm_bench.py);
+- end-to-end: a --fused-matmul BatchEngine (pipelined + speculative +
+  model drafter) and the T-bucket verify programs emit tokens IDENTICAL to
+  the kernel-off engine, greedy and seeded-stochastic, with the selection
+  registry proving the kernels actually served (no vacuous pass through
+  the XLA fallback).
+"""
+
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.models.params import (init_random_params,
+                                                 prepare_for_pallas)
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.ops.pallas_q4_mm import (q4_gated_matmul,
+                                                    q4_gated_supported,
+                                                    q4_matmul)
+from distributed_llama_tpu.quants import FloatType, QTensor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "perf"))
+
+import q4_mm_bench  # noqa: E402
+
+
+def _w(n, k, seed=0):
+    import jax
+    rng = np.random.RandomState(seed)
+    qt = QTensor.from_float(rng.randn(n, k).astype(np.float32) * 0.02,
+                            FloatType.Q40).to_i4p_layout()
+    return jax.tree_util.tree_map(jnp.asarray, qt)
+
+
+def test_q4_matmul_residual_epilogue_matches():
+    m, n, k = 8, 256, 1024
+    w = _w(n, k)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32) * 0.1)
+    res = jnp.asarray(rng.randn(m, n).astype(np.float32) * 0.1)
+    want = (np.asarray(res, np.float32)
+            + np.asarray(x, np.float32) @ np.asarray(
+                w.dequantize(dtype=jnp.float32)).T)
+    got = q4_matmul(x, w, out_dtype=jnp.float32, residual=res,
+                    interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("act", ["silu", "gelu_tanh"])
+def test_q4_gated_matmul_matches(act):
+    m, n, k = 8, 256, 1024
+    w1, w3 = _w(n, k, seed=2), _w(n, k, seed=3)
+    assert q4_gated_supported(w1, w3, m)
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32) * 0.1)
+    h1 = np.asarray(x, np.float32) @ np.asarray(
+        w1.dequantize(dtype=jnp.float32)).T
+    h3 = np.asarray(x, np.float32) @ np.asarray(
+        w3.dequantize(dtype=jnp.float32)).T
+    if act == "silu":
+        want = h1 / (1.0 + np.exp(-h1)) * h3
+    else:
+        c = 0.7978845608028654
+        want = 0.5 * h1 * (1.0 + np.tanh(c * (h1 + 0.044715 * h1 ** 3))) * h3
+    got = q4_gated_matmul(x, w1, w3, act=act, out_dtype=jnp.float32,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-2, rtol=5e-2)
+
+
+def test_gated_supported_gates():
+    w1, w3 = _w(256, 1024, seed=5), _w(256, 1024, seed=6)
+    assert q4_gated_supported(w1, w3, 8)
+    w_narrow = _w(128, 1024, seed=7)
+    assert not q4_gated_supported(w1, w_narrow, 8)  # mismatched pair
+    with pytest.raises(ValueError):
+        q4_gated_matmul(jnp.ones((8, 1024), jnp.bfloat16), w1, w3,
+                        act="tanh", interpret=True)
+
+
+def test_bench_byte_model_within_packed_density():
+    """Satellite smoke: at EVERY serving bucket x op the analytic HBM
+    traffic of a fused dispatch is <= packed-weight bytes x 2 (the
+    'small constant' bar — weights dominate; the dequantized bf16 image
+    alone would be 3.56x), and the weight stream is exactly Q40 packed
+    density (0.5625 B/weight)."""
+    for bucket, m, shapes in q4_mm_bench.BUCKETS:
+        for n, k in shapes:
+            for kw in ({}, {"residual": True}, {"gated": True}):
+                rec = q4_mm_bench.hbm_model(m, n, k, **kw)
+                assert rec["ratio"] <= 2.0, (bucket, m, n, k, kw, rec)
+                assert rec["density"] == 0.5625, (bucket, rec)
+
+
+def test_bench_kernels_consistent_with_xla_oracle():
+    """Satellite smoke: interpret-mode kernels vs the XLA dequant+dot
+    oracle — close in f32 AND identical greedy argmax per row, on every
+    fused variant (mm, mm+res, gated)."""
+    problems = q4_mm_bench.check_consistency()
+    assert problems == [], "\n".join(problems)
+
+
+def _spec():
+    # dim 1024: K/2 = 512 tiles exactly (ops/pallas_q4_mm._pick_bkp), so the
+    # fused kernels actually serve — a non-tileable dim would shape-gate to
+    # XLA and verify nothing; the registry assertion below guards that.
+    # (dim 512 tiles too, but its bkp=256 two-step accumulation order rounds
+    # differently enough from the XLA dot to flip near-tie greedy argmaxes
+    # at this vocab — the single-K-tile dim keeps the identity bar exact.)
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=1024, hidden_dim=1024,
+                     n_layers=2, n_heads=8, n_kv_heads=8, vocab_size=256,
+                     seq_len=32, rope_type=RopeType.LLAMA).resolved()
+
+
+REP = [7, 31, 5, 102] * 4  # n-gram-dense: engages the verify path
+
+
+def _run_batch(spec, params, reqs, *, draft=False, **kw):
+    from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+    from distributed_llama_tpu.runtime.sampler import Sampler
+
+    V = spec.vocab_size
+    if draft:
+        kw["draft_model"] = (spec, params)
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4, pipeline=True,
+                     speculative=4, spec_min_draft=1, **kw)
+    try:
+        subs = [be.submit(list(p), gen, Sampler(V, temperature=temp,
+                                                seed=seed))
+                for p, gen, temp, seed in reqs]
+        return [r.wait(timeout=300) for r in subs]
+    finally:
+        be.close()
+
+
+def test_batch_engine_fused_token_identity():
+    """The acceptance gate: a fused BatchEngine (pipelined + speculative,
+    with the co-resident model drafter so its k-step scan runs the kernels
+    too) emits tokens IDENTICAL to the kernel-off engine for greedy AND
+    seeded-stochastic requests, and the selection registry proves all
+    three kernel families served (q4_mm for wqkv/wcls, q4_mm+res for
+    wo/w2, q4_gated_mm for the w1/w3 pair) — the fallback recording would
+    expose a silently-degraded run."""
+    from distributed_llama_tpu.ops.matmul import (kernel_selections,
+                                                  reset_kernel_selections)
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=5)
+    reqs = [(REP, 8, 0.0, 0),               # greedy, verify-engaging
+            ([1, 9, 2, 7], 8, 0.0, 0),      # greedy, scan path
+            (REP, 6, 0.8, 11)]              # seeded stochastic
+    want = _run_batch(spec, params, reqs, draft=True)
+    reset_kernel_selections()
+    got = _run_batch(spec, params, reqs, draft=True, use_pallas=True,
+                     fused_matmul=True)
+    assert got == want
+    sel = set(kernel_selections().values())
+    assert {"q4_mm", "q4_mm+res", "q4_gated_mm"} <= sel, sel
+
+
+@pytest.mark.parametrize("t", [2, 3, 5, 9])
+def test_verify_bucket_fused_matches_dense(t):
+    """Verify-bucket sweep: the (B, T) verify program under
+    use_pallas="fused" returns the same targets/accepts/frontier as the
+    dense XLA reference at every reachable T bucket."""
+    from distributed_llama_tpu.ops.rope import RopeTables
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.parallel.tp import (init_sharded_kv_cache,
+                                                   shard_params)
+    from distributed_llama_tpu.runtime.device_loop import \
+        make_batched_verify_loop
+
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=9)
+    mesh = make_mesh(tp=1)
+    rope = RopeTables.create(spec)
+    b = 2
+    rng = np.random.RandomState(t)
+    proposals = rng.randint(0, spec.vocab_size, size=(b, t)).astype(np.int32)
+    start = np.zeros((b,), np.int32)
+    rstate = np.ones((b, 2), np.uint32)
+    temp = np.zeros((b,), np.float32)
+    topp = np.ones((b,), np.float32)
+    ndraft = np.full((b,), t - 1, np.int32)
+
+    def run(p, up):
+        loop = make_batched_verify_loop(spec, mesh, p, t, mode="greedy",
+                                        use_pallas=up, donate_cache=False)
+        kc, vc = init_sharded_kv_cache(spec, mesh, batch=b)
+        toks, acc, tok, pos, _rng, _kc, _vc = loop(
+            p, rope, proposals, kc, vc, start, rstate, temp, topp, ndraft)
+        return (np.asarray(toks).tolist(), np.asarray(acc).tolist(),
+                np.asarray(tok).tolist(), np.asarray(pos).tolist())
+
+    base = shard_params(params, mesh, spec)
+    want = run(base, False)
+    pp = shard_params(
+        prepare_for_pallas(params, spec=spec, keep_gate_pair=True),
+        mesh, spec)
+    got = run(pp, "fused")
+    assert got == want
