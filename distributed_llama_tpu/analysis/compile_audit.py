@@ -124,9 +124,19 @@ class CompileAudit:
             up = kw.get("use_pallas")
             return f",kernel={up}" if isinstance(up, str) else ""
 
+        def _mask(kw):
+            # grammar-constrained variants (constrain/, docs/SERVING.md
+            # "Constrained decoding"): masked programs are SEPARATE
+            # lowerings (constraint-table operands + automaton carry) and
+            # pin under their own keys. Boolean policy: the default
+            # (unmasked) adds nothing, so every pre-existing pinned key is
+            # unchanged.
+            return ",mask=1" if kw.get("masked") else ""
+
         def _static(kw):
             return (f"mode={kw.get('mode', 'greedy')},"
-                    f"window={kw.get('attn_window')}{_paged(kw)}{_kern(kw)}")
+                    f"window={kw.get('attn_window')}"
+                    f"{_paged(kw)}{_kern(kw)}{_mask(kw)}")
 
         self._patch_factory(
             engine, "make_sharded_forward",
@@ -325,6 +335,49 @@ def run_scenario(keep_engine: bool = False):
             rfv.wait(60)
         finally:
             eng3.close()
+        # phase 9 — grammar-constrained decoding (constrain/,
+        # docs/SERVING.md "Constrained decoding"): constrained rows
+        # co-batched with a plain row on a FOURTH engine, greedy AND
+        # seeded-stochastic, with speculation on so the GrammarProposer's
+        # forced chains engage the masked verify buckets. Masked programs
+        # pin under their own mask=1 keys (separate lowerings: constraint
+        # table operands + automaton carry); the unmasked keys must stay
+        # untouched — a masked dispatch minting a bucket outside the
+        # pinned t set, or leaking onto an unmasked key, fails the gate
+        # by name.
+        from ..constrain import byte_vocab, compile_grammar
+
+        cv = byte_vocab(V)
+        aut, gh = compile_grammar(
+            "json_schema",
+            {"type": "object", "properties": {
+                "name": {"enum": ["alpha", "beta"]},
+                "ok": {"type": "boolean"}}}, cv, eos_id=2)
+        eng4 = BatchEngine(spec, params, slots=2, superstep=4,
+                           pipeline=True, speculative=4, spec_min_draft=1,
+                           tp=1, prefix_cache=True)
+        try:
+            rc1 = eng4.submit(p1, 12, Sampler(V), constraint=aut,
+                              constraint_hash=gh)
+            rc2 = eng4.submit(rep, 12, Sampler(V))  # plain co-batched row
+            rc1.wait(60)
+            rc2.wait(60)
+            rcs = eng4.submit(p2, 10, Sampler(V, temperature=0.8, seed=7),
+                              constraint=aut, constraint_hash=gh)
+            rcs.wait(60)
+            # a branching-only grammar (no singleton-mask states, so the
+            # GrammarProposer never drafts and n-gram finds nothing on a
+            # fresh prompt): constrained rows ride the masked K-step SCAN
+            # buckets — greedy and sampled — instead of verify
+            aut2, gh2 = compile_grammar("regex", "[a-z]{24}", cv, eos_id=2)
+            rm1 = eng4.submit(p1, 10, Sampler(V), constraint=aut2,
+                              constraint_hash=gh2)
+            rm1.wait(60)
+            rm2 = eng4.submit(p2, 8, Sampler(V, temperature=0.8, seed=7),
+                              constraint=aut2, constraint_hash=gh2)
+            rm2.wait(60)
+        finally:
+            eng4.close()
         ok = True
     finally:
         # a failed phase must not leak a live engine (scheduler thread +

@@ -197,6 +197,10 @@ class ApiState:
         self.default_sampler = default_sampler
         self.device_loop_chunk = device_loop_chunk
         self.speculative_k = speculative_k
+        # constrained decoding (docs/SERVING.md "Constrained decoding"):
+        # per-token byte pieces the grammar compiler lowers against,
+        # resolved lazily on the first response_format request
+        self.constrain_vocab: list[bytes] | None = None
         self.model_name = "distributed-llama-tpu"
 
 
@@ -310,6 +314,12 @@ def _stats_payload(state: "ApiState") -> dict:
             # degradation) + per-row adaptive-k breakdown
             # (docs/SERVING.md "Model-based drafting")
             out["speculative"] = spec_block
+        # constrained decoding (docs/SERVING.md "Constrained decoding"):
+        # edge compile-cache health + engine table occupancy/degradations
+        from ..constrain import compile_stats
+
+        out["constrain"] = dict(be.constrain_stats(),
+                                compile=compile_stats())
     elif state.engine is not None:
         eng = state.engine
         out["engine"] = {"pos": eng.pos, "tp": eng.tp, "sp": eng.sp,
@@ -370,6 +380,68 @@ def _parse_resume(body: dict, spec) -> list[int]:
         raise InvalidRequest(
             f"'resume.tokens' must be token ids in [0, {spec.vocab_size})")
     return list(toks)
+
+
+def _parse_response_format(state: "ApiState", body: dict, runner):
+    """Validate + compile `response_format` at the edge (docs/SERVING.md
+    "Constrained decoding") — BEFORE any queue work, so a malformed or
+    unsupported grammar is an honest 400 invalid_request_error, never a
+    stalled slot. Returns (TokenAutomaton, grammar_hash) or (None, "").
+
+    Accepted forms (grammar source under its own key, OpenAI-style
+    `{"json_schema": {"schema": {...}}}` nesting also honored):
+
+      {"type": "json_schema", "json_schema": {...}}
+      {"type": "regex",       "regex": "..."}
+      {"type": "grammar",     "grammar": "root ::= ..."}
+      {"type": "text"}   (explicit no-op)
+
+    Compiles are LRU-cached by grammar hash (constrain/compiler.py), so a
+    templated schema pays DFA construction once per process."""
+    rf = body.get("response_format")
+    if rf is None:
+        return None, ""
+    if not isinstance(rf, dict) or not isinstance(rf.get("type"), str):
+        raise InvalidRequest(
+            "'response_format' must be an object with a string 'type' "
+            "(json_schema | regex | grammar | text)")
+    kind = rf["type"]
+    if kind == "text":
+        return None, ""
+    if kind not in ("json_schema", "regex", "grammar"):
+        raise InvalidRequest(
+            f"unsupported response_format type {kind!r} "
+            "(want json_schema | regex | grammar | text)")
+    if state.batch_engine is None:
+        raise InvalidRequest(
+            "response_format requires the batched engine (--batch >= 2); "
+            "this server runs the sequential engine")
+    tok = runner.tokenizer
+    if tok is None:
+        raise InvalidRequest(
+            "response_format requires a tokenizer (token-level grammar "
+            "masks are compiled against the served vocab)")
+    source = rf.get(kind)
+    if kind == "json_schema" and isinstance(source, dict) \
+            and "schema" in source:
+        source = source["schema"]  # OpenAI response_format nesting
+    if source is None:
+        raise InvalidRequest(
+            f"response_format type {kind!r} needs the grammar under the "
+            f"{kind!r} key")
+    from ..constrain import CompileError, compile_grammar, vocab_bytes
+
+    if state.constrain_vocab is None:
+        state.constrain_vocab = vocab_bytes(tok)
+    eos = getattr(tok, "chat_eos_id", None) or tok.eos_id
+    try:
+        aut, ghash = compile_grammar(kind, source, state.constrain_vocab,
+                                     eos)
+    except CompileError as e:
+        raise InvalidRequest(f"invalid response_format: {e}") from None
+    flight.event(None, "constrain_compiled", kind=kind, grammar=ghash,
+                 states=aut.n_states)
+    return aut, ghash
 
 
 def run_completion(state: ApiState, body: dict, emit, *, journal=None,
@@ -444,6 +516,10 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
     if isinstance(mt_raw, bool) or not isinstance(mt_raw, int) or mt_raw < 0:
         raise InvalidRequest(
             f"'max_tokens' must be a non-negative integer, got {mt_raw!r}")
+    # grammar compile at the edge (docs/SERVING.md "Constrained decoding"):
+    # malformed/unsupported grammars 400 here, before any queue work; the
+    # engine receives a ready automaton and never needs tokenizer bytes
+    constraint, constraint_hash = _parse_response_format(state, body, runner)
     # disaggregated admission (docs/DISAGG.md): a router-injected kv_source
     # descriptor means a prefill replica already computed this prompt's KV —
     # pull the blocks into the prefix cache BEFORE admission so the radix
@@ -549,7 +625,8 @@ def run_completion(state: ApiState, body: dict, emit, *, journal=None,
                 prompt + resume, max_tokens, sampler, on_token=on_token,
                 stop_check=qstreamer.stop_check,
                 deadline=eff_deadline or None,
-                resume_tokens=len(resume), tenant=tenant, klass=klass)
+                resume_tokens=len(resume), tenant=tenant, klass=klass,
+                constraint=constraint, constraint_hash=constraint_hash)
             # sentinel closes the drain loop the moment the request completes
             # (the puts happen-before done.set(), so everything queued is
             # drained first)

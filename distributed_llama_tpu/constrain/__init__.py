@@ -1,0 +1,23 @@
+"""Grammar-constrained decoding (docs/SERVING.md "Constrained decoding").
+
+JSON Schema / regex / EBNF grammars each lower to ONE token-level mask
+automaton (automaton.py): a byte DFA over the tokenizer vocab precompiled
+to per-state packed uint32 bitmask rows plus a dense transition table. The
+BatchEngine stacks attached automata into a device-resident table and the
+batched decode/verify scans gather+apply the mask before the split-uint32
+sampler (runtime/device_loop.py, masked=True variants); GrammarProposer
+walks forced-transition chains so the constraint itself drafts the
+guaranteed-accept continuation (runtime/speculative.py ProposerMux).
+"""
+
+from .automaton import CompileError, TokenAutomaton
+from .compiler import (byte_vocab, compile_grammar, compile_stats,
+                       grammar_hash, vocab_bytes)
+from .proposer import GrammarProposer
+from .table import ConstraintTable
+
+__all__ = [
+    "CompileError", "TokenAutomaton", "GrammarProposer", "ConstraintTable",
+    "byte_vocab", "compile_grammar", "compile_stats", "grammar_hash",
+    "vocab_bytes",
+]

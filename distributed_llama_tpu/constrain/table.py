@@ -1,0 +1,91 @@
+"""Device-resident stacked constraint tables (docs/SERVING.md).
+
+Every attached grammar's local automaton is rebased into ONE pair of
+fixed-capacity arrays so the masked decode/verify programs keep a stable
+jit signature regardless of which grammars are co-batched:
+
+  mask  (cap, W) uint32   per-GLOBAL-state packed allowed bitmask
+  delta (cap, V) int32    GLOBAL next state per token
+
+Row 0 is the universal state — mask all-ones, every token self-loops —
+and is what unconstrained co-batched rows ride: for them the masked
+program's `where(allowed, rows, NEG)` is the identity and the state
+gather is loop-invariant, so their tokens are bit-identical to the
+unmasked program's. Local dead transitions (-1) rebase to state 0; they
+are unreachable under masked sampling (the mask already excluded the
+token) and only ever indexed past a rejected verify position, where the
+result is discarded.
+
+Scheduler-thread-only (allocation at admission, release at finish); the
+device upload is lazy and happens at most once per attach/detach, never
+per dispatch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .automaton import TokenAutomaton
+
+
+class ConstraintTable:
+    def __init__(self, vocab_size: int, capacity: int = 512):
+        self.vocab = vocab_size
+        self.words = (vocab_size + 31) // 32
+        self.cap = capacity
+        self._mask = np.zeros((capacity, self.words), np.uint32)
+        self._mask[0] = 0xFFFFFFFF
+        self._delta = np.zeros((capacity, vocab_size), np.int32)
+        self._regions: dict[int, tuple[int, int]] = {}  # row -> (off, n)
+        self._dev = None  # (mask, delta) jnp pair, rebuilt when dirty
+
+    @property
+    def active_rows(self) -> int:
+        return len(self._regions)
+
+    def room_for(self, n_states: int) -> bool:
+        return n_states <= self.cap - 1
+
+    def alloc(self, row: int, aut: TokenAutomaton) -> int | None:
+        """Rebase `aut` into a free span; returns the global offset, or
+        None when the table is full (the engine degrades that row to
+        unconstrained — a capacity condition, not a client error)."""
+        assert row not in self._regions
+        n = aut.n_states
+        off = self._find_span(n)
+        if off is None:
+            return None
+        self._mask[off:off + n] = aut.mask
+        self._delta[off:off + n] = np.where(aut.delta >= 0,
+                                            aut.delta + off, 0)
+        self._regions[row] = (off, n)
+        self._dev = None
+        return off
+
+    def free(self, row: int) -> None:
+        reg = self._regions.pop(row, None)
+        if reg is None:
+            return
+        off, n = reg
+        self._mask[off:off + n] = 0
+        self._delta[off:off + n] = 0
+        self._dev = None
+
+    def _find_span(self, n: int) -> int | None:
+        # first-fit over the gaps between allocated regions (row 0 reserved)
+        taken = sorted(self._regions.values())
+        cur = 1
+        for off, size in taken:
+            if off - cur >= n:
+                return cur
+            cur = max(cur, off + size)
+        return cur if self.cap - cur >= n else None
+
+    def device(self):
+        """(mask, delta) as device arrays, re-uploaded only when an
+        attach/detach dirtied the host copy."""
+        if self._dev is None:
+            import jax.numpy as jnp
+
+            self._dev = (jnp.asarray(self._mask), jnp.asarray(self._delta))
+        return self._dev

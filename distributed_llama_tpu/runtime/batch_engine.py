@@ -242,6 +242,23 @@ _WEDGE_FAILED = metrics.counter(
     "engine_wedge_failed_requests_total",
     "In-flight/queued requests failed with EngineWedged by a supervisor "
     "recovery (retriable: a durable router resumes them elsewhere)")
+# Grammar-constrained decoding (constrain/, docs/SERVING.md "Constrained
+# decoding"): rows with an attached TokenAutomaton, masked dispatches
+# issued, and rows degraded to unconstrained output (mask fault or table
+# capacity — a service condition, never a client-visible failure).
+_CONSTRAIN_ROWS = metrics.gauge(
+    "constrain_rows",
+    "Batch rows currently decoding under an attached grammar automaton")
+_CONSTRAIN_DISPATCHES = metrics.counter(
+    "constrain_masked_dispatches_total",
+    "Batched decode/verify dispatches issued through the masked program "
+    "variants (>= 1 live constrained row in the batch)")
+_CONSTRAIN_DEGRADED = metrics.counter(
+    "constrain_degraded_total",
+    "Constrained rows degraded to unconstrained decoding, by reason "
+    "(capacity = constraint table full, mask = masking fault, "
+    "divergence = delivered token left the grammar)",
+    labelnames=("reason",))
 
 
 # Donated single-block pool updates (docs/PAGED_KV.md copy-on-write and
@@ -317,6 +334,14 @@ class BatchRequest:
     # absolute perf_counter bound on QUEUE time only (expired before a slot
     # was assigned -> finish "deadline" without ever prefilling); 0 = none
     queue_ttl_t: float = 0.0
+    # grammar-constrained decoding (constrain/, docs/SERVING.md "Constrained
+    # decoding"): a compiled TokenAutomaton the OUTPUT must satisfy, plus
+    # the grammar hash the api edge logged. The engine allocates a region
+    # in its device constraint table at admission and masks sampling (host
+    # and device) to the automaton's allowed set; compile happens at the
+    # edge so the engine never needs tokenizer bytes.
+    constraint: object = None  # constrain.TokenAutomaton | None
+    constraint_hash: str = ""
 
     def cancel(self) -> None:
         """Ask the scheduler to stop decoding this request (client went away)."""
@@ -334,6 +359,34 @@ class BatchRequest:
         if self.error is not None:
             raise self.error
         return self.out
+
+
+class _SlotConstraint:
+    """Per-slot grammar state (scheduler-thread-only, constrain/).
+
+    `state` is the LOCAL automaton state, the host mirror of the device
+    carry — advanced in _emit per DELIVERED token, so after any full
+    delivery host and device agree exactly (integer bookkeeping, no
+    resync needed; a flushed/partial dispatch re-uploads from here, same
+    discipline as the sampler rng). `offset` rebases local states into
+    the engine's stacked ConstraintTable; `degraded` parks the row on the
+    universal state 0 (unconstrained) after a mask fault or capacity
+    miss — visible in metrics and the flight timeline, never to the
+    client."""
+
+    __slots__ = ("automaton", "state", "offset", "ghash", "degraded")
+
+    def __init__(self, automaton, offset: int, ghash: str = ""):
+        self.automaton = automaton
+        self.state = 0
+        self.offset = offset
+        self.ghash = ghash
+        self.degraded = False
+
+    @property
+    def gstate(self) -> int:
+        """GLOBAL table state uploaded to device (0 = universal row)."""
+        return 0 if self.degraded else self.offset + self.state
 
 
 class _Slot:
@@ -374,6 +427,10 @@ class _Slot:
         # per-token hot path (_emit) pays a bound-method call, not a label
         # dict lookup
         self.tok_counter = None
+        # grammar constraint handle (constrain/): attached at admission
+        # when the request carries an automaton, advanced in _emit,
+        # released (table region freed) at finish/preempt/wedge
+        self.constraint: _SlotConstraint | None = None
 
 
 class _InflightStep:
@@ -395,10 +452,12 @@ class _InflightStep:
     device, so a chained scan consumes it soundly for any accept outcome."""
 
     __slots__ = ("rows", "k", "starts", "budget", "temps", "toks", "tok",
-                 "pos", "rng", "t_issue", "chained", "kind", "ndraft", "acc")
+                 "pos", "rng", "t_issue", "chained", "kind", "ndraft", "acc",
+                 "cstate")
 
     def __init__(self, rows, k, starts, budget, temps, toks, tok, pos, rng,
-                 t_issue, chained, kind="scan", ndraft=None, acc=None):
+                 t_issue, chained, kind="scan", ndraft=None, acc=None,
+                 cstate=None):
         self.rows = rows  # list[(slot, request)] for budget > 0 rows
         self.k = k
         self.starts = starts  # expected per-row device start positions
@@ -413,6 +472,9 @@ class _InflightStep:
         self.kind = kind  # "scan" | "verify"
         self.ndraft = ndraft  # verify: per-row draft counts (-1 = parked)
         self.acc = acc  # verify: device (B,) accepted draft lengths
+        # masked dispatch only: device (B,) GLOBAL constraint states after
+        # the budgeted emissions — a chained masked scan consumes it
+        self.cstate = cstate
 
 
 class BatchEngine:
@@ -432,6 +494,7 @@ class BatchEngine:
                  spec_min_draft: int = 1, spec_chain_expect: float = 2.0,
                  spec_adaptive: bool = True,
                  draft_model=None, draft_k: int = 0,
+                 constrain_states: int = 512,
                  tenants: TenantRegistry | None = None,
                  slo_ttft_interactive: float = 0.0,
                  slo_ttft_batch: float = 0.0,
@@ -591,7 +654,21 @@ class BatchEngine:
 
                 print(f"⚠️  draft model unavailable ({e!r}) — degrading to "
                       "n-gram drafting", file=sys.stderr, flush=True)
-        self.proposer = ProposerMux(NgramProposer(), self.drafter)
+        # Grammar-constrained decoding (constrain/, docs/SERVING.md
+        # "Constrained decoding"): the stacked device constraint table is
+        # created lazily at the first constrained admission (unconstrained
+        # engines never pay the (cap, V) host arrays), and the
+        # GrammarProposer rides the mux so constrained rows draft their
+        # forced-transition chains while co-batched chat rows keep
+        # model/ngram drafts.
+        from ..constrain import GrammarProposer
+
+        self.constrain_states = max(int(constrain_states), 2)
+        self.constrain_table = None  # ConstraintTable, lazy
+        self.constrain_degraded = 0
+        self.grammar_proposer = GrammarProposer()
+        self.proposer = ProposerMux(NgramProposer(), self.drafter,
+                                    grammar=self.grammar_proposer)
         self.prefilled_tokens = 0  # observability: total tokens run through prefill
         self.decode_steps = 0  # observability: batched device decode dispatches
         self.super_steps = 0  # observability: K-step fused dispatches (subset)
@@ -685,7 +762,8 @@ class BatchEngine:
                ttl: float | None = None, rid: str | None = None,
                ctx=None, resume_tokens: int = 0, tenant: str = "",
                klass: str = "interactive",
-               export_kv: bool = False) -> BatchRequest:
+               export_kv: bool = False, constraint=None,
+               constraint_hash: str = "") -> BatchRequest:
         """Enqueue a request. `deadline` (seconds) bounds the WHOLE request
         (queue + generation; finish reason "deadline", partial output kept);
         `ttl` bounds queue wait only (overrides the engine's queue_ttl).
@@ -746,6 +824,20 @@ class BatchEngine:
         req.klass = klass
         req.wfq_cost = cost
         req.export_kv = export_kv
+        if constraint is not None:
+            # structural rejects belong at submit (the api edge maps them
+            # to 400): an automaton that can NEVER fit the table is a
+            # client error, not the runtime capacity condition alloc
+            # degrades on
+            if getattr(constraint, "n_states", 0) > self.constrain_states - 1:
+                if self.tenants is not None:
+                    self.tenants.refund(tenant, cost)
+                raise InvalidRequest(
+                    f"grammar too large: {constraint.n_states} automaton "
+                    f"states exceed the engine's constraint table "
+                    f"({self.constrain_states - 1} usable states)")
+            req.constraint = constraint
+            req.constraint_hash = constraint_hash
         if not req.prompt:
             req.prompt = [self.tokenizer.bos_id if self.tokenizer else 1]
         req.resume_tokens = min(max(int(resume_tokens), 0), len(req.prompt))
@@ -940,6 +1032,20 @@ class BatchEngine:
             }
         return out
 
+    def constrain_stats(self) -> dict:
+        """Constrained-decoding block for /v1/stats (docs/SERVING.md
+        "Constrained decoding"): rows currently decoding under a grammar,
+        table capacity, and degradations. The api layer merges the edge's
+        compile-cache stats (constrain.compile_stats) alongside."""
+        tbl = self.constrain_table
+        return {
+            "active_rows": tbl.active_rows if tbl is not None else 0,
+            "table_states": self.constrain_states,
+            "table_used": (sum(n for _off, n in tbl._regions.values())
+                           if tbl is not None else 0),
+            "degraded": self.constrain_degraded,
+        }
+
     def _dispatch_age(self) -> float:
         """Watchdog reading: 0 while nothing is in flight (an idle scheduler
         is not a hung one); otherwise seconds since the scheduler last made
@@ -1007,6 +1113,11 @@ class BatchEngine:
             # fresh slot objects FIRST: the abandoned thread's locals hold
             # refs to the old list, so nothing it does can reach new requests
             self._slots = [_Slot(i) for i in range(self.slots_n)]
+            # constraint table regions were keyed by the old slots; drop the
+            # whole table (re-created lazily at the next constrained
+            # admission) rather than freeing per-row under a wedged epoch
+            self.constrain_table = None
+            _CONSTRAIN_ROWS.set(0)
             for s in old_slots:
                 if self.prefix_cache is not None and s.lease is not None:
                     self.prefix_cache.release(s.lease)
@@ -1210,6 +1321,7 @@ class BatchEngine:
                 self.adaptive.attach(best.index)
         else:
             self.proposer.detach(best.index)
+        self._attach_constraint(best, req)
         # per-tenant delivery counter child, resolved once per admission so
         # the per-token _emit path pays no label lookup
         best.tok_counter = _TENANT_TOKENS.labels(
@@ -1235,6 +1347,83 @@ class BatchEngine:
                      **({"resume_tokens": req.resume_tokens}
                         if req.resume_tokens else {}))
         return best
+
+    def _attach_constraint(self, slot: _Slot, req: BatchRequest) -> None:
+        """Bind the request's grammar automaton to the slot: allocate a
+        region in the (lazy) device constraint table, replay any
+        already-delivered tokens through the automaton so preemption
+        re-admission and durable resume continue from the right grammar
+        state, and register the live handle with the GrammarProposer. A
+        full table degrades this row to unconstrained (counter + flight
+        event, never a client failure)."""
+        slot.constraint = None
+        self.grammar_proposer.detach(slot.index)
+        aut = req.constraint
+        if aut is None:
+            if self.constrain_table is not None:
+                _CONSTRAIN_ROWS.set(self.constrain_table.active_rows)
+            return
+        if self.constrain_table is None:
+            from ..constrain import ConstraintTable
+
+            self.constrain_table = ConstraintTable(
+                self.spec.vocab_size, self.constrain_states)
+        off = self.constrain_table.alloc(slot.index, aut)
+        if off is None:
+            self.constrain_degraded += 1
+            _CONSTRAIN_DEGRADED.labels(reason="capacity").inc()
+            flight.event(req.rid, "constrain_degraded", reason="capacity",
+                         grammar=req.constraint_hash)
+            _CONSTRAIN_ROWS.set(self.constrain_table.active_rows)
+            return
+        sc = _SlotConstraint(aut, off, req.constraint_hash)
+        # tokens the grammar already consumed: a resume prefix (last
+        # resume_tokens of the prompt — generated elsewhere) then any
+        # preemption-delivered output. A replay token outside the grammar
+        # means the constraint cannot be honored from here — degrade
+        # honestly rather than emit a mask for the wrong state.
+        replay = (req.prompt[len(req.prompt) - req.resume_tokens:]
+                  if req.resume_tokens else [])
+        for t in list(replay) + list(req.out):
+            nxt = aut.advance(sc.state, t)
+            if nxt < 0:
+                sc.degraded = True
+                self.constrain_degraded += 1
+                _CONSTRAIN_DEGRADED.labels(reason="divergence").inc()
+                flight.event(req.rid, "constrain_degraded",
+                             reason="divergence", grammar=sc.ghash)
+                break
+            sc.state = nxt
+        slot.constraint = sc
+        self.grammar_proposer.attach_constraint(slot.index, sc)
+        flight.event(req.rid, "constrain_attached", grammar=sc.ghash,
+                     states=aut.n_states, offset=off)
+        _CONSTRAIN_ROWS.set(self.constrain_table.active_rows)
+
+    def _release_constraint(self, slot: _Slot) -> None:
+        """Free the slot's constraint-table region (finish/preempt/wedge).
+        The proposer-side registration is cleared by ProposerMux.detach at
+        the same call sites."""
+        slot.constraint = None
+        if self.constrain_table is not None:
+            self.constrain_table.free(slot.index)
+            _CONSTRAIN_ROWS.set(self.constrain_table.active_rows)
+
+    def _degrade_constraint(self, slot: _Slot, reason: str) -> None:
+        """Park the row on the universal (unconstrained) table state after
+        a masking fault or grammar divergence — decoding continues, the
+        constraint is dropped, and the degradation is visible in
+        constrain_degraded_total and the flight timeline (the documented
+        fallback: degrade > fail, docs/ROBUSTNESS.md)."""
+        sc = slot.constraint
+        if sc is None or sc.degraded:
+            return
+        sc.degraded = True
+        self.constrain_degraded += 1
+        _CONSTRAIN_DEGRADED.labels(reason=reason).inc()
+        if slot.req is not None:
+            flight.event(slot.req.rid, "constrain_degraded", reason=reason,
+                         grammar=sc.ghash)
 
     def _seed_from_cache(self, slot: _Slot, req: BatchRequest,
                          reuse: int, full: list[int] | None = None) -> int:
@@ -1614,6 +1803,7 @@ class BatchEngine:
         if self.adaptive is not None:
             self.adaptive.detach(slot.index)
         slot.tok_counter = None
+        self._release_constraint(slot)
         # service-rate bookkeeping (docs/SERVING.md "Multi-tenant serving"):
         # one completion noted to the drain estimator — the denominator of
         # every Retry-After hint — plus per-tenant completion accounting
@@ -1882,6 +2072,10 @@ class BatchEngine:
         if self.adaptive is not None:
             self.adaptive.detach(slot.index)
         slot.tok_counter = None
+        # the grammar state is NOT kept across preemption: re-admission
+        # replays prompt ⊕ delivered through the automaton in
+        # _attach_constraint, the same rebuild-from-truth the proposer does
+        self._release_constraint(slot)
         harvest = None
         if self.prefix_cache is not None:
             if slot.lease is not None:
@@ -2149,6 +2343,19 @@ class BatchEngine:
             # proposer corpus/frontier sync: every DELIVERED token, in
             # order (no-op for rows with no drafting state attached)
             self.proposer.push(slot.index, token)
+            sc = slot.constraint
+            if sc is not None and not sc.degraded:
+                # host mirror of the device constraint carry: exact integer
+                # bookkeeping per delivered token, so after a full delivery
+                # no device readback or resync is ever needed. A token the
+                # grammar disallows can only arrive off a degraded/unmasked
+                # path — park the row unconstrained rather than mask from a
+                # wrong state.
+                nxt = sc.automaton.advance(sc.state, token)
+                if nxt < 0:
+                    self._degrade_constraint(slot, "divergence")
+                else:
+                    sc.state = nxt
             req.stats.generated_tokens += 1
             _DECODE_TOKENS.inc()
             if slot.tok_counter is not None:  # per-tenant delivery share
@@ -2185,8 +2392,29 @@ class BatchEngine:
         if req.max_tokens <= 0:  # parity with Engine.generate: zero-token request
             self._finish(slot, "length")
             return False
+        logits = slot.last_logits
+        sc = slot.constraint
+        if sc is not None and not sc.degraded:
+            # host-side grammar enforcement (the T=1 / post-prefill sampling
+            # site): the SAME finite mask value the masked device programs
+            # use, so host- and device-sampled tokens agree bit-for-bit
+            # under an identical rng stream. A masking fault degrades this
+            # row to unconstrained — never fails the request.
+            try:
+                faults.fire("constrain.mask", slot=slot.index)
+                from .device_loop import MASK_NEG
+
+                allowed = sc.automaton.mask_bool(sc.state)
+                arr = np.array(logits, dtype=np.float32).reshape(-1)  # dlint: ignore[hot-sync] -- logits arrive host-side for the sampler anyway; masking rides the same transfer
+                n = min(arr.shape[0], allowed.shape[0])
+                arr[:n][~allowed[:n]] = np.float32(MASK_NEG)
+                arr[n:] = np.float32(MASK_NEG)  # vocab padding: never legal
+                logits = arr
+            except Exception:
+                self._degrade_constraint(slot, "mask")
+                logits = slot.last_logits
         try:
-            token = req.sampler.sample(slot.last_logits)
+            token = req.sampler.sample(logits)
             alive = self._emit(slot, token)
         except Exception as e:
             # a broken callback (e.g. client disconnect mid-stream) fails ONLY
@@ -2364,10 +2592,16 @@ class BatchEngine:
             slot.req.stats.infer_ms.append(dt_ms)
             slot.req.stats.dispatch_ms.append(dt_ms)
 
-    def _batched_loop(self, k: int, mode: str, window: int | None):
+    def _batched_loop(self, k: int, mode: str, window: int | None,
+                      masked: bool = False):
         """Compiled K-step batched device loop for this engine's config
-        (one program per (k, mode, window-bucket), memoized)."""
-        key = (k, mode, window)
+        (one program per (k, mode, window-bucket), memoized). `masked`
+        selects the grammar-constrained variant (constraint-table mask
+        applied before sampling, automaton state in the carry) — a
+        SEPARATE program keyed with a masked flag, so unconstrained
+        service keeps today's exact pinned programs (perf/dlint.py
+        compile manifest)."""
+        key = (k, mode, window) if not masked else (k, mode, window, "mask")
         if key not in self._loops:
             from .device_loop import make_batched_decode_loop
 
@@ -2380,13 +2614,19 @@ class BatchEngine:
                 moe_sharding=eng.moe_sharding,
                 fused_prologue=eng.fused_prologue,
                 kv_block_tokens=self._kv_bt,
-                paged_kernel=eng.paged_kernel)
+                paged_kernel=eng.paged_kernel,
+                masked=masked)
         return self._loops[key]
 
-    def _verify_loop(self, t: int, mode: str, window: int | None):
+    def _verify_loop(self, t: int, mode: str, window: int | None,
+                     masked: bool = False):
         """Compiled (B, T=t) draft-verify program for this engine's config
-        (one per (t, mode, window-bucket), memoized alongside the scans)."""
-        key = ("verify", t, mode, window)
+        (one per (t, mode, window-bucket), memoized alongside the scans).
+        `masked` selects the grammar-constrained variant — target rows are
+        masked position-by-position along the proposal's state chain, so a
+        draft token the grammar forbids can never be accepted."""
+        key = (("verify", t, mode, window) if not masked
+               else ("verify", t, mode, window, "mask"))
         if key not in self._loops:
             from .device_loop import make_batched_verify_loop
 
@@ -2399,8 +2639,36 @@ class BatchEngine:
                 moe_sharding=eng.moe_sharding,
                 fused_prologue=eng.fused_prologue,
                 kv_block_tokens=self._kv_bt,
-                paged_kernel=eng.paged_kernel)
+                paged_kernel=eng.paged_kernel,
+                masked=masked)
         return self._loops[key]
+
+    def _constrained(self, rows) -> bool:
+        """True when any live row in this dispatch decodes under a
+        non-degraded grammar — the masked program variants engage only
+        then, so purely-unconstrained batches never pay the mask gather."""
+        return any(s.constraint is not None and not s.constraint.degraded
+                   for s, _req in rows)
+
+    def _cstate_vec(self) -> np.ndarray:
+        """(B,) GLOBAL constraint-table states from the host mirrors —
+        uploaded when a masked dispatch is NOT chained (the chained case
+        consumes the predecessor's device carry). Rows without a grammar
+        ride the universal state 0. The constrain.mask fault point fires
+        here per constrained row: an injected error degrades that row
+        (documented fallback), latency models a slow mask fetch."""
+        cs = np.zeros(self.slots_n, np.int32)
+        for s in self._slots:
+            sc = s.constraint
+            if sc is None:
+                continue
+            if not sc.degraded:
+                try:
+                    faults.fire("constrain.mask", slot=s.index)
+                except Exception:
+                    self._degrade_constraint(s, "mask")
+            cs[s.index] = sc.gstate
+        return cs
 
     def _verify_block_for(self, t: int) -> int:
         """Block-length bucket (2, 3, 5, 9, 17, ... capped at 1+spec_k):
@@ -2531,23 +2799,46 @@ class BatchEngine:
             rng[i] = state >> 32, state & 0xFFFFFFFF
         mode = "greedy" if greedy else "sample"
         window = eng._window_for(min(max(starts) + t, self.spec.seq_len))
-        loop = self._verify_loop(t, mode, window)
+        masked = self._constrained(rows)
+        loop = self._verify_loop(t, mode, window, masked)
         if self._gap_t is not None:
             _DISPATCH_GAP.observe(max(time.perf_counter() - self._gap_t, 0.0))
         t_issue = time.perf_counter()
         kc_in, vc_in = eng.k_cache, eng.v_cache  # same stale-epoch discipline
         tables = self._tables() if self.kv_pool is not None else None
+        constrain = None
+        if masked:
+            # a verify is never chained FROM, so its constraint states come
+            # from the fully-delivered host mirrors — same as the rng
+            # a mask fault inside _cstate_vec degrades that row to the
+            # universal state 0 — the masked program then passes its logits
+            # through untouched, so the dispatch itself stays valid
+            cmask, cdelta = self.constrain_table.device()
+            constrain = (jnp.asarray(self._cstate_vec()), cmask, cdelta)
+            _CONSTRAIN_DISPATCHES.inc()
         with trace.span("batch.verify_issue",
                         {"block": t, "rows": len(rows),
                          "drafted": sum(max(n, 0) for n in ndraft)}):
-            def call():
-                toks, acc, tok, pos, rng_out, kc, vc = loop(
-                    eng.params, eng.rope, props, kc_in, vc_in,
-                    starts, rng, temps, topps, ndraft, tables)
-                return toks, acc, tok, pos, rng_out, kc, vc
+            if masked:
+                def call():
+                    toks, acc, tok, pos, rng_out, kc, vc, cst = loop(
+                        eng.params, eng.rope, props, kc_in, vc_in,
+                        starts, rng, temps, topps, ndraft, tables,
+                        constrain=constrain)
+                    return toks, acc, tok, pos, rng_out, kc, vc, cst
 
-            (toks, acc, tok, pos, rng_out, eng.k_cache,
-             eng.v_cache) = self._dispatched("verify", call)
+                (toks, acc, tok, pos, rng_out, eng.k_cache,
+                 eng.v_cache, cst) = self._dispatched("verify", call)
+            else:
+                def call():
+                    toks, acc, tok, pos, rng_out, kc, vc = loop(
+                        eng.params, eng.rope, props, kc_in, vc_in,
+                        starts, rng, temps, topps, ndraft, tables)
+                    return toks, acc, tok, pos, rng_out, kc, vc
+
+                (toks, acc, tok, pos, rng_out, eng.k_cache,
+                 eng.v_cache) = self._dispatched("verify", call)
+                cst = None
         _PIPELINE_DEPTH.set(1)
         for a in (toks, acc, rng_out):
             try:
@@ -2556,7 +2847,7 @@ class BatchEngine:
                 pass
         return _InflightStep(rows, t, starts, budget, temps, toks, tok, pos,
                              rng_out, t_issue, False, kind="verify",
-                             ndraft=ndraft, acc=acc)
+                             ndraft=ndraft, acc=acc, cstate=cst)
 
     def _drafts_ready(self, rows: list) -> bool:
         """Cheap probe: would a verify dispatch have material to work with?
@@ -2752,7 +3043,8 @@ class BatchEngine:
         window = eng._window_for(min(max(st + max(b, 1)
                                          for st, b in zip(starts, budget)),
                                      self.spec.seq_len))
-        loop = self._batched_loop(k, mode, window)
+        masked = self._constrained(rows)
+        loop = self._batched_loop(k, mode, window, masked)
         if chain is None:
             tok_in, pos_in, rng_in = tokens, starts, rng
             if self._gap_t is not None:
@@ -2766,17 +3058,42 @@ class BatchEngine:
         t_issue = time.perf_counter()
         kc_in, vc_in = eng.k_cache, eng.v_cache  # same stale-epoch discipline
         tables = self._tables() if self.kv_pool is not None else None
+        constrain = None
+        if masked:
+            # constraint carry: a chained dispatch consumes the
+            # predecessor's device-resident states (same rule as tok/rng);
+            # an unchained one uploads the host mirrors. A predecessor
+            # issued masked always carries cstate — _constrained() is
+            # deterministic in the (identical) row set, so the chain never
+            # crosses the masked/unmasked program boundary.
+            cmask, cdelta = self.constrain_table.device()
+            cin = (chain.cstate if chain is not None and chain.cstate
+                   is not None else jnp.asarray(self._cstate_vec()))
+            constrain = (cin, cmask, cdelta)
+            _CONSTRAIN_DISPATCHES.inc()
         with trace.span("batch.super_step_issue",
                         {"k": k, "rows": len(rows),
                          "chained": chain is not None}):
-            def call():
-                toks, tok, pos, rng_out, kc, vc = loop(
-                    eng.params, eng.rope, tok_in, kc_in, vc_in,
-                    pos_in, rng_in, temps, topps, budget, tables)
-                return toks, tok, pos, rng_out, kc, vc
+            if masked:
+                def call():
+                    toks, tok, pos, rng_out, kc, vc, cst = loop(
+                        eng.params, eng.rope, tok_in, kc_in, vc_in,
+                        pos_in, rng_in, temps, topps, budget, tables,
+                        constrain=constrain)
+                    return toks, tok, pos, rng_out, kc, vc, cst
 
-            (toks, tok, pos, rng_out, eng.k_cache,
-             eng.v_cache) = self._dispatched("super_step", call)
+                (toks, tok, pos, rng_out, eng.k_cache,
+                 eng.v_cache, cst) = self._dispatched("super_step", call)
+            else:
+                def call():
+                    toks, tok, pos, rng_out, kc, vc = loop(
+                        eng.params, eng.rope, tok_in, kc_in, vc_in,
+                        pos_in, rng_in, temps, topps, budget, tables)
+                    return toks, tok, pos, rng_out, kc, vc
+
+                (toks, tok, pos, rng_out, eng.k_cache,
+                 eng.v_cache) = self._dispatched("super_step", call)
+                cst = None
         _PIPELINE_DEPTH.set(2 if chain is not None else 1)
         for a in (toks, rng_out):
             try:  # start the non-blocking host copy now; delivery's
@@ -2784,7 +3101,7 @@ class BatchEngine:
             except Exception:  # an optimization hint only — e.g. dp-sharded
                 pass  # outputs may refuse the whole-array async copy
         return _InflightStep(rows, k, starts, budget, temps, toks, tok, pos,
-                             rng_out, t_issue, chain is not None)
+                             rng_out, t_issue, chain is not None, cstate=cst)
 
     # hot-path
     def _deliver_super_step(self, fl: _InflightStep) -> dict[int, str]:

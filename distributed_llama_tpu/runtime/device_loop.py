@@ -240,6 +240,27 @@ def make_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *, mode: str =
     return run
 
 
+# disallowed-logit fill for grammar masking (constrain/): finite so the
+# softmax shift never meets inf-inf, large enough that exp underflows to 0
+# exactly — the host mask path (batch_engine._advance_row) uses the SAME
+# constant so host and device masked samples stay bit-compatible
+MASK_NEG = -1e30
+
+
+# hot-path: traced
+def _apply_token_mask(rows, mrow):
+    """Lower disallowed logits: `mrow` is the packed uint32 allowed bitmask
+    gathered per row (..., W) from the constrain table; bit v&31 of word
+    v>>5 covers token v. Universal rows (all-ones) make this the identity,
+    so unconstrained co-batched rows are bit-identical to the unmasked
+    program."""
+    v = rows.shape[-1]
+    vi = jnp.arange(v, dtype=jnp.int32)
+    words = jnp.take(mrow, vi >> 5, axis=-1)  # (..., V)
+    allowed = (words >> (vi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+    return jnp.where(allowed.astype(bool), rows, jnp.float32(MASK_NEG))
+
+
 def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
                              mode: str = "greedy", dtype=None,
                              use_pallas: bool = False,
@@ -250,7 +271,8 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
                              moe_sharding: str = "slice",
                              fused_prologue: bool = False,
                              kv_block_tokens: int = 0,
-                             paged_kernel: bool = False):
+                             paged_kernel: bool = False,
+                             masked: bool = False):
     """Batched K-step super-step: `lax.scan` over n_steps decode steps for ALL
     cache rows at once, sampling on device — the serving-path generalization of
     make_decode_loop (B=1) that converts the BatchEngine's hot loop from one
@@ -292,6 +314,18 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
     built fn takes a trailing (B, W) block-table argument mapping each
     row's virtual positions to pool blocks (loop-invariant across the scan;
     the scheduler ensures coverage for every budgeted write pre-dispatch).
+
+    masked=True builds the grammar-constrained variant (constrain/,
+    docs/SERVING.md "Constrained decoding"): the per-row automaton state
+    rides the scan carry, each step gathers the state's packed bitmask row
+    from the device-resident constrain table, lowers disallowed logits to
+    MASK_NEG BEFORE the greedy argmax / split-uint32 sampler, and advances
+    the state through the emitted token. run() then takes
+    constrain=(cstate (B,) int32 GLOBAL states, mask (S, W) uint32,
+    delta (S, V) int32) and appends the final automaton state to its
+    outputs. Rows at state 0 (the universal row) sample identically to the
+    unmasked program; the unmasked build is byte-for-byte today's program
+    so its pinned dispatch signature is untouched.
     """
     from ..parallel.mesh import AXIS_DP
 
@@ -319,11 +353,11 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
 
     # hot-path: traced
     def loop(p, rope_cos, rope_sin, tokens, kc, vc, start_pos, rng_hi, rng_lo,
-             temperature, topp, budget, tables):
+             temperature, topp, budget, tables, cstate, cmask, cdelta):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
 
         def step(carry, i):
-            tok, pos, sh, sl, kc, vc = carry
+            tok, pos, sh, sl, cst, kc, vc = carry
             live = i < budget  # (B,)
             # parked rows write scratch at their current position (clamped to
             # stay in-cache); reads mask slots >= start_pos so it is invisible,
@@ -333,6 +367,8 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
                                  k_cache=kc, v_cache=vc, start_pos=step_pos,
                                  block_tables=tables if paged else None)
             rows = logits[:, -1].astype(jnp.float32)  # (B, vocab)
+            if masked:
+                rows = _apply_token_mask(rows, cmask[cst])
             if mode == "greedy":
                 nxt = jnp.argmax(rows, axis=-1).astype(jnp.int32)
             else:
@@ -342,42 +378,70 @@ def make_batched_decode_loop(spec: ModelSpec, mesh, params, n_steps: int, *,
                 drew = live & (temperature != 0.0)
                 sh = jnp.where(drew, nsh, sh)
                 sl = jnp.where(drew, nsl, sl)
+            if masked:
+                # advance the automaton through the emitted token (a masked
+                # sample is always an allowed transition)
+                cst = jnp.where(live, cdelta[cst, nxt], cst)
             tok = jnp.where(live, nxt, tok)
             pos = jnp.where(live, pos + 1, pos)
-            return (tok, pos, sh, sl, kc, vc), nxt
+            return (tok, pos, sh, sl, cst, kc, vc), nxt
 
-        (tok, pos, sh, sl, kc, vc), toks = jax.lax.scan(
-            step, (tokens, start_pos, rng_hi, rng_lo, kc, vc),
+        (tok, pos, sh, sl, cst, kc, vc), toks = jax.lax.scan(
+            step, (tokens, start_pos, rng_hi, rng_lo, cstate, kc, vc),
             jnp.arange(n_steps, dtype=jnp.int32))
-        return toks, tok, pos, sh, sl, kc, vc
+        return toks, tok, pos, sh, sl, cst, kc, vc
 
     from ..compat import shard_map
 
     row = P(AXIS_DP) if dp > 1 else P()
     toks_out = P(None, AXIS_DP) if dp > 1 else P()
-    sharded = shard_map(
-        loop, mesh=mesh,
-        in_specs=(param_specs, P(), P(), row, kv_spec, kv_spec, row, row, row,
-                  row, row, row, P()),
-        out_specs=(toks_out, row, row, row, row, kv_spec, kv_spec),
-        check_vma=False,
-    )
+
+    if masked:
+        in_specs = (param_specs, P(), P(), row, kv_spec, kv_spec, row, row,
+                    row, row, row, row, P(), row, P(), P())
+        out_specs = (toks_out, row, row, row, row, row, kv_spec, kv_spec)
+        sharded = shard_map(loop, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False)
+    else:
+        # the unmasked build keeps today's exact program arity so its
+        # pinned compile-manifest signature is untouched (boolean policy)
+        def plain(p, rope_cos, rope_sin, tokens, kc, vc, start_pos, rng_hi,
+                  rng_lo, temperature, topp, budget, tables):
+            cz = jnp.zeros(tokens.shape, jnp.int32)
+            toks, tok, pos, sh, sl, _, kc, vc = loop(
+                p, rope_cos, rope_sin, tokens, kc, vc, start_pos, rng_hi,
+                rng_lo, temperature, topp, budget, tables, cz, None, None)
+            return toks, tok, pos, sh, sl, kc, vc
+
+        sharded = shard_map(
+            plain, mesh=mesh,
+            in_specs=(param_specs, P(), P(), row, kv_spec, kv_spec, row, row,
+                      row, row, row, row, P()),
+            out_specs=(toks_out, row, row, row, row, kv_spec, kv_spec),
+            check_vma=False,
+        )
     donate = (4, 5) if donate_cache else ()
     jitted = jax.jit(sharded, donate_argnums=donate)
 
     # hot-path
     def run(p, rope: RopeTables, tokens, kc, vc, start_pos, rng, temperature,
-            topp, budget, tables=None):
+            topp, budget, tables=None, constrain=None):
         faults.fire("device_loop.batched_dispatch", n_steps=n_steps)
         rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
         if tables is None:
             tables = jnp.zeros((rng.shape[0], 1), jnp.int32)  # dense: unused
-        toks, tok, pos, sh, sl, kc, vc = jitted(
-            p, rope.cos, rope.sin, jnp.asarray(tokens, jnp.int32), kc, vc,
-            jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(topp, jnp.float32), jnp.asarray(budget, jnp.int32),
-            jnp.asarray(tables, jnp.int32))
+        args = (p, rope.cos, rope.sin, jnp.asarray(tokens, jnp.int32), kc, vc,
+                jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(topp, jnp.float32), jnp.asarray(budget, jnp.int32),
+                jnp.asarray(tables, jnp.int32))
+        if masked:
+            cstate, cmask, cdelta = constrain
+            toks, tok, pos, sh, sl, cst, kc, vc = jitted(
+                *args, jnp.asarray(cstate, jnp.int32), cmask, cdelta)
+            return (toks, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc,
+                    cst)
+        toks, tok, pos, sh, sl, kc, vc = jitted(*args)
         return toks, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc
 
     return run
@@ -393,7 +457,8 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
                              moe_sharding: str = "slice",
                              fused_prologue: bool = False,
                              kv_block_tokens: int = 0,
-                             paged_kernel: bool = False):
+                             paged_kernel: bool = False,
+                             masked: bool = False):
     """Batched draft-verify super-step: ONE (B, T=block) forward ingests each
     row's proposal block and on-device acceptance turns it into up to T
     tokens per row — the speculative-decoding counterpart of
@@ -428,6 +493,18 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
     stream samples target i, so accepted-or-corrected tokens consume coins
     in exactly the host Sampler's order and a chained scan dispatch
     (runtime/batch_engine.py) can consume the carry for ANY accept outcome.
+
+    masked=True is the grammar-constrained variant (constrain/): the
+    automaton state chain is advanced along each row's PROPOSAL tokens, so
+    position i's target is sampled under the mask of the state reached
+    after drafts 0..i-1 — masked verify validates an accepted block
+    position-by-position, and a draft token the grammar disallows can
+    never be accepted (its position's masked target cannot equal it). The
+    returned frontier state is the automaton advanced through exactly the
+    acc+1 EMITTED tokens (proposal-path states equal emitted-path states
+    for every accepted position). run() takes constrain=(cstate, mask,
+    delta) like the masked decode loop and appends the frontier state to
+    its outputs; the unmasked build keeps today's program untouched.
     """
     from ..parallel.mesh import AXIS_DP
 
@@ -455,7 +532,8 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
 
     # hot-path: traced
     def loop(p, rope_cos, rope_sin, proposals, kc, vc, start_pos, rng_hi,
-             rng_lo, temperature, topp, ndraft, tables):
+             rng_lo, temperature, topp, ndraft, tables, cstate, cmask,
+             cdelta):
         rope = RopeTables(rope_cos, rope_sin, rope_type)
         b = proposals.shape[0]
         live = ndraft >= 0  # (B,)
@@ -463,6 +541,17 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
                              v_cache=vc, start_pos=start_pos,
                              block_tables=tables if paged else None)
         rows = logits.astype(jnp.float32)  # (B, T, vocab)
+        if masked:
+            # automaton states along the PROPOSAL path: position i's target
+            # is masked by the state after drafts 0..i-1 (st_chain[i]); the
+            # chain equals the emitted-token path for every position up to
+            # and including the first mismatch, which is all the scheduler
+            # ever delivers
+            sts = [cstate]
+            for i in range(1, block):
+                sts.append(cdelta[sts[-1], proposals[:, i]])
+            st_chain = jnp.stack(sts)  # (T, B)
+            rows = _apply_token_mask(rows, cmask[st_chain.T])  # (B, T, V)
         if mode == "greedy":
             targets = jnp.argmax(rows, axis=-1).astype(jnp.int32)  # (B, T)
         else:
@@ -496,6 +585,13 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
             drew = live & (temperature != 0.0)
             rng_hi = jnp.where(drew, shs[acc, ridx], rng_hi)
             rng_lo = jnp.where(drew, sls[acc, ridx], rng_lo)
+        if masked:
+            # frontier automaton state: the chain state at the accept
+            # boundary advanced through the emitted correction/bonus token
+            # (`last` was sampled under st_chain[acc]'s mask, so the
+            # transition is always an allowed one)
+            cst = jnp.where(live, cdelta[st_chain[acc, ridx], last], cstate)
+            return targets.T, acc, last, pos, rng_hi, rng_lo, cst, kc, vc
         return targets.T, acc, last, pos, rng_hi, rng_lo, kc, vc
 
     from ..compat import shard_map
@@ -503,29 +599,54 @@ def make_batched_verify_loop(spec: ModelSpec, mesh, params, block: int, *,
     row = P(AXIS_DP) if dp > 1 else P()
     mat = P(AXIS_DP, None) if dp > 1 else P()
     toks_out = P(None, AXIS_DP) if dp > 1 else P()
-    sharded = shard_map(
-        loop, mesh=mesh,
-        in_specs=(param_specs, P(), P(), mat, kv_spec, kv_spec, row, row, row,
-                  row, row, row, P()),
-        out_specs=(toks_out, row, row, row, row, row, kv_spec, kv_spec),
-        check_vma=False,
-    )
+    if masked:
+        sharded = shard_map(
+            loop, mesh=mesh,
+            in_specs=(param_specs, P(), P(), mat, kv_spec, kv_spec, row, row,
+                      row, row, row, row, P(), row, P(), P()),
+            out_specs=(toks_out, row, row, row, row, row, row, kv_spec,
+                       kv_spec),
+            check_vma=False,
+        )
+    else:
+        # unmasked arity unchanged: the pinned verify[...] signatures in
+        # perf/compile_manifest.json stay exactly as before (boolean policy)
+        def plain(p, rope_cos, rope_sin, proposals, kc, vc, start_pos,
+                  rng_hi, rng_lo, temperature, topp, ndraft, tables):
+            cz = jnp.zeros(proposals.shape[:1], jnp.int32)
+            return loop(p, rope_cos, rope_sin, proposals, kc, vc, start_pos,
+                        rng_hi, rng_lo, temperature, topp, ndraft, tables,
+                        cz, None, None)
+
+        sharded = shard_map(
+            plain, mesh=mesh,
+            in_specs=(param_specs, P(), P(), mat, kv_spec, kv_spec, row, row,
+                      row, row, row, row, P()),
+            out_specs=(toks_out, row, row, row, row, row, kv_spec, kv_spec),
+            check_vma=False,
+        )
     donate = (4, 5) if donate_cache else ()
     jitted = jax.jit(sharded, donate_argnums=donate)
 
     # hot-path
     def run(p, rope: RopeTables, proposals, kc, vc, start_pos, rng,
-            temperature, topp, ndraft, tables=None):
+            temperature, topp, ndraft, tables=None, constrain=None):
         faults.fire("device_loop.verify_dispatch", block=block)
         rng = jnp.asarray(rng, jnp.uint32).reshape(-1, 2)
         if tables is None:
             tables = jnp.zeros((rng.shape[0], 1), jnp.int32)  # dense: unused
-        toks, acc, tok, pos, sh, sl, kc, vc = jitted(
-            p, rope.cos, rope.sin, jnp.asarray(proposals, jnp.int32), kc, vc,
-            jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(topp, jnp.float32), jnp.asarray(ndraft, jnp.int32),
-            jnp.asarray(tables, jnp.int32))
+        args = (p, rope.cos, rope.sin, jnp.asarray(proposals, jnp.int32), kc,
+                vc, jnp.asarray(start_pos, jnp.int32), rng[:, 0], rng[:, 1],
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(topp, jnp.float32), jnp.asarray(ndraft, jnp.int32),
+                jnp.asarray(tables, jnp.int32))
+        if masked:
+            cstate, cmask, cdelta = constrain
+            toks, acc, tok, pos, sh, sl, cst, kc, vc = jitted(
+                *args, jnp.asarray(cstate, jnp.int32), cmask, cdelta)
+            return (toks, acc, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc,
+                    cst)
+        toks, acc, tok, pos, sh, sl, kc, vc = jitted(*args)
         return toks, acc, tok, pos, jnp.stack([sh, sl], axis=1), kc, vc
 
     return run

@@ -363,15 +363,23 @@ class ProposerMux:
     propose failures disable the drafter for the engine's lifetime —
     n-gram-only from then on, exactly the pre-drafter behavior.
 
+    `grammar` (constrain.GrammarProposer) is consulted FIRST for rows it
+    serves: a grammar-constrained row whose automaton sits on a
+    forced-transition chain drafts that chain — the target's only legal
+    continuation, guaranteed accept, zero drafting compute — while
+    co-batched unconstrained rows in the SAME want dict fall through to
+    the model/ngram routing unchanged.
+
     Scheduler-thread-only except stats()/describe() (reads of counters and
     the drafter's own locked stats — torn reads only skew a stats scrape)."""
 
     name = "mux"
 
     def __init__(self, ngram: NgramProposer, drafter=None, *,
-                 max_failures: int = 8):
+                 grammar=None, max_failures: int = 8):
         self.ngram = ngram
         self.drafter = drafter
+        self.grammar = grammar
         self.max_failures = max_failures
         self.failures = 0  # consecutive; reset on success
         self.errors = 0  # lifetime (stats)
@@ -392,6 +400,8 @@ class ProposerMux:
         self.ngram.detach(row)
         if self.drafter is not None:
             self.drafter.detach(row)
+        if self.grammar is not None:
+            self.grammar.detach(row)
         self.last_src.pop(row, None)
 
     def push(self, row: int, tok: int) -> None:
@@ -404,9 +414,21 @@ class ProposerMux:
 
     def propose_batch(self, want: dict[int, int]) -> dict[int, list[int]]:
         out: dict[int, list[int]] = {}
+        # grammar first: forced-chain drafts are certain accepts, so they
+        # always beat a learned draft for the rows they cover; remaining
+        # (unconstrained / off-chain) rows keep the model/ngram routing
+        if self.grammar is not None:
+            for row, d in self.grammar.propose_batch(want).items():
+                out[row] = d
+                self.last_src[row] = "grammar"
+                _PROPOSED.labels(proposer="grammar").inc(len(d))
+            want = {row: k for row, k in want.items() if row not in out}
+            if not want:
+                return out
+        mout: dict[int, list[int]] = {}
         if self._model_ok():
             try:
-                out = self.drafter.propose_batch(want)
+                mout = self.drafter.propose_batch(want)
                 self.failures = 0
             except Exception as e:
                 # a failing drafter costs only its drafts — every row falls
@@ -422,8 +444,9 @@ class ProposerMux:
                     print(f"🔴 model drafter disabled after "
                           f"{self.failures} consecutive failures: {e!r} — "
                           "degrading to n-gram drafting", file=sys.stderr)
-                out = {}
-        for row, d in out.items():
+                mout = {}
+        for row, d in mout.items():
+            out[row] = d
             self.last_src[row] = "model"
             _PROPOSED.labels(proposer="model").inc(len(d))
         for row, k in want.items():
@@ -446,6 +469,9 @@ class ProposerMux:
     def ready(self, row: int, k: int, min_draft: int) -> bool:
         if k <= 0:
             return False
+        if self.grammar is not None and self.grammar.ready(row, k,
+                                                           min_draft):
+            return True  # a forced chain long enough is a certain accept
         if self._model_ok() and self.drafter.can_serve(row, k):
             return True  # a model drafts k tokens whenever it can run
         return self.ngram.ready(row, k, min_draft)
@@ -456,6 +482,8 @@ class ProposerMux:
                "errors": self.errors}
         if d is not None:
             out["drafter"] = d.stats()
+        if self.grammar is not None:
+            out["grammar"] = self.grammar.stats()
         return out
 
 

@@ -465,6 +465,151 @@ def run_fused_family() -> tuple[int, list[str]]:
     return cells, problems
 
 
+# ----------------------------------------------------------------------
+# grammar-constrained decoding family (constrain/, docs/SERVING.md
+# "Constrained decoding"; docs/ROBUSTNESS.md): the documented degradation
+# ladder under injected faults, × {pipelined, serialized}.
+#
+#   constrain.compile — fires at the EDGE (constrain/compiler.py), before
+#     any queue work: an injected error surfaces to the caller (the api
+#     maps it to an honest 400 invalid_request_error) and the ENGINE never
+#     sees the request — co-batched service is untouched, byte-for-byte.
+#   constrain.mask — fires on the engine's masking paths (host sample +
+#     masked dispatch state upload): an error DEGRADES that row to
+#     unconstrained decoding (constrain_degraded_total, flight event) and
+#     the request completes without a client-visible failure; latency
+#     merely delays. Co-batched unconstrained survivors stay
+#     token-identical to the fault-free reference in every cell.
+# ----------------------------------------------------------------------
+
+CONSTRAIN_PROMPT = [1, 5, 9]
+CONSTRAIN_GEN = 30
+CONSTRAIN_POINTS = ("constrain.compile", "constrain.mask")
+CONSTRAIN_KINDS = ("error", "latency")
+CONSTRAIN_CELLS = (len(CONSTRAIN_POINTS) * len(CONSTRAIN_KINDS)
+                   * 2)  # × {pipelined, serialized}
+
+
+def _constrain_grammar():
+    from distributed_llama_tpu.constrain import byte_vocab, compile_grammar
+
+    cv = byte_vocab(256)
+    aut, gh = compile_grammar(
+        "json_schema",
+        {"type": "object", "properties": {
+            "name": {"enum": ["alpha", "beta"]},
+            "ok": {"type": "boolean"}}}, cv, eos_id=2)
+    return cv, aut, gh
+
+
+def run_constrain_cell(spec, be, point: str, kind: str, refs: dict,
+                       aut, gh: str, cv, tag: str) -> list[str]:
+    from distributed_llama_tpu.constrain import compile_grammar
+
+    name = f"constrain {tag} {point}/{kind}"
+    problems: list[str] = []
+    deg0 = be.constrain_degraded
+    fs = _spec_for(point, kind)
+    with faults.active(fs):
+        if point == "constrain.compile":
+            # the edge path: compile fails/stalls BEFORE any queue work —
+            # the engine never sees the request (honest 400 at the api)
+            try:
+                compile_grammar("regex", "[0-9]{4}", cv, eos_id=2)
+                compiled = True
+            except Exception:
+                compiled = False
+            if kind == "error" and compiled:
+                problems.append(f"{name}: injected compile fault vanished")
+            if kind == "latency" and not compiled:
+                problems.append(f"{name}: latency injection failed the "
+                                "compile")
+        # engine-side service under the armed fault: one constrained row
+        # co-batched with one plain row (speculation on — grammar drafts
+        # on the constrained row, n-gram on the repetitive plain row)
+        rc = be.submit(list(CONSTRAIN_PROMPT), CONSTRAIN_GEN, _greedy(spec),
+                       constraint=aut, constraint_hash=gh)
+        rp = be.submit(list(DRAFT_PROMPTS[0]), DRAFT_GEN, _greedy(spec))
+        for label, r, ref in (("constrained", rc, refs["constrained"]),
+                              ("plain", rp, refs["plain"])):
+            try:
+                out = r.wait(timeout=120)
+            except Exception as e:
+                problems.append(f"{name}: client-visible {label} failure "
+                                f"{e!r}")
+                continue
+            if r.error is not None:
+                problems.append(f"{name}: {label} request errored "
+                                f"{r.error!r}")
+                continue
+            if label == "plain" and out != ref:
+                # the blast-radius promise: an unconstrained co-batched
+                # survivor is token-identical in EVERY cell
+                problems.append(f"{name}: co-batched plain row diverged "
+                                "from fault-free reference")
+            if label == "constrained" and out != ref and not (
+                    point == "constrain.mask" and kind == "error"):
+                # mask/error legitimately degrades the victim to
+                # unconstrained output; every other cell must emit the
+                # fault-free constrained tokens exactly
+                problems.append(f"{name}: constrained output diverged "
+                                "from fault-free reference")
+    faults.uninstall()
+    if fs.fired == 0:
+        problems.append(f"{name}: fault never fired (vacuous cell)")
+    if (point == "constrain.mask" and kind == "error"
+            and be.constrain_degraded == deg0):
+        problems.append(f"{name}: mask fault did not degrade the "
+                        "constrained row (vacuous cell)")
+    if not be.scheduler_alive():
+        problems.append(f"{name}: scheduler thread DIED")
+        return problems
+    # post-fault probe: constrained service fully restored
+    try:
+        probe = be.submit(list(CONSTRAIN_PROMPT), CONSTRAIN_GEN,
+                          _greedy(spec), constraint=aut, constraint_hash=gh)
+        out = probe.wait(timeout=120)
+        if out != refs["constrained"] or probe.error is not None:
+            problems.append(f"{name}: probe degraded "
+                            f"({len(out)} tokens, err={probe.error!r})")
+    except Exception as e:
+        problems.append(f"{name}: probe failed: {e!r}")
+    with be._plock:
+        leaked = [s for s in be._slots
+                  if s.req is not None or s.lease is not None]
+    if leaked:
+        problems.append(f"{name}: slot/lease leak")
+    if be.constrain_table is not None and be.constrain_table.active_rows:
+        problems.append(f"{name}: constraint-table region leak")
+    return problems
+
+
+def run_constrain_family() -> tuple[int, list[str]]:
+    cv, aut, gh = _constrain_grammar()
+    cells = 0
+    problems: list[str] = []
+    for pipeline in (True, False):
+        tag = "pipelined" if pipeline else "serialized"
+        spec, be = build_batch_engine(pipeline=pipeline, speculative=4)
+        try:
+            refs = {
+                "constrained": be.submit(
+                    list(CONSTRAIN_PROMPT), CONSTRAIN_GEN, _greedy(spec),
+                    constraint=aut, constraint_hash=gh).wait(timeout=120),
+                "plain": be.submit(
+                    list(DRAFT_PROMPTS[0]), DRAFT_GEN,
+                    _greedy(spec)).wait(timeout=120),
+            }
+            for point in CONSTRAIN_POINTS:
+                for kind in CONSTRAIN_KINDS:
+                    cells += 1
+                    problems += run_constrain_cell(spec, be, point, kind,
+                                                   refs, aut, gh, cv, tag)
+        finally:
+            be.close()
+    return cells, problems
+
+
 def build_engine(paged: bool = False):
     from distributed_llama_tpu.runtime.engine import Engine
 
@@ -1519,6 +1664,13 @@ def run_matrix(include_paged: bool = True,
     k_cells, k_problems = run_fused_family()
     cells += k_cells
     problems += k_problems
+    # grammar-constrained decoding: compile faults stop at the edge
+    # (honest 400, no queue work), mask faults degrade that row to
+    # unconstrained decoding, co-batched survivors token-identical
+    # (ISSUE 17, docs/SERVING.md "Constrained decoding")
+    c_cells, c_problems = run_constrain_family()
+    cells += c_cells
+    problems += c_problems
     return cells, problems
 
 

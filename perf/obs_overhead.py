@@ -14,6 +14,9 @@ exactly:
     1 disabled flight.event() (global check; kwargs dict built at call site)
     1 reqctx.use() enter/exit (contextvar set + reset — the scheduler's
       per-request trace re-entry)
+    1 constrain-disabled scan (ISSUE 17: every masked-capable dispatch asks
+      "is any co-batched row constrained?" — B attribute loads returning
+      None — before picking the unmasked program)
 
 This script times that exact bundle standalone, times a real T=1 decode
 dispatch of the tiny CI model shape on the current backend, and asserts
@@ -62,6 +65,14 @@ def bench_instrumentation_bundle(n: int = 200_000) -> float:
     hist = metrics.histogram("obs_overhead_bench_seconds", "bench-only")
     ctr = metrics.counter("obs_overhead_bench_total", "bench-only")
     ctx = reqctx.new_context("req-bench")
+
+    class _Slot:  # the constrain-disabled scan: B rows, constraint None
+        __slots__ = ("constraint",)
+
+        def __init__(self):
+            self.constraint = None
+
+    slots = [_Slot() for _ in range(8)]
     t_start = time.perf_counter()
     for i in range(n):
         with reqctx.use(ctx):
@@ -72,6 +83,13 @@ def bench_instrumentation_bundle(n: int = 200_000) -> float:
             hist.observe(dt)
             ctr.inc()
             flight.event("req-bench", "super_step", k=8, delivered=8)
+            masked = False
+            for s in slots:  # batch_engine._constrained(rows)
+                sc = s.constraint
+                if sc is not None and not sc.degraded:
+                    masked = True
+                    break
+            assert not masked
     return (time.perf_counter() - t_start) / n
 
 

@@ -1,0 +1,411 @@
+"""Grammar-constrained decoding tests (ISSUE 17; docs/SERVING.md
+"Constrained decoding").
+
+The constrain/ package lowers JSON Schema / regex / EBNF grammars to one
+token-level mask automaton; the batch engine applies the mask before the
+sampler on every path (host prefill-boundary sample, masked batched scan,
+masked verify) and the GrammarProposer drafts forced-transition chains
+with guaranteed accept. Load-bearing properties:
+
+- the automaton's per-state masks match a brute-force oracle (the
+  enumerated prefix-closure of the grammar's language) on every reachable
+  state, for random finite regexes and JSON schemas;
+- constrained output is ALWAYS grammar-valid, and identical to the
+  unconstrained stream wherever the grammar permits the unconstrained
+  token (greedy: the outputs share a prefix up to the first position the
+  grammar actually had to veto);
+- batched vs sequential, co-batched vs solo, speculation on vs off
+  (±GrammarProposer) are all byte-identical — constraining one row
+  leaves a co-batched unconstrained row untouched;
+- a masked program shape outside the pinned compile manifest fails the
+  gate BY NAME (mask=1 in the cache key), never aliasing the unmasked
+  pin.
+"""
+
+import itertools
+import re
+
+import numpy as np
+import pytest
+
+from distributed_llama_tpu.constrain import (CompileError, byte_vocab,
+                                             compile_grammar, compile_stats,
+                                             grammar_hash)
+from distributed_llama_tpu.models.params import init_random_params
+from distributed_llama_tpu.models.spec import ArchType, ModelSpec, RopeType
+from distributed_llama_tpu.quants import FloatType
+from distributed_llama_tpu.runtime.batch_engine import BatchEngine
+from distributed_llama_tpu.resilience.errors import InvalidRequest
+from distributed_llama_tpu.runtime.sampler import Sampler
+
+EOS = 2
+VOCAB = byte_vocab(256)
+
+# greedy decode of the seed-11 tiny model enters a repetitive attractor on
+# this n-gram-dense prompt, so speculative verify dispatches engage
+REP = [7, 31, 5, 102, 9, 31, 5, 77]
+
+
+# ----------------------------------------------------------------------
+# automaton vs brute-force oracle
+# ----------------------------------------------------------------------
+
+# finite languages over a tiny alphabet: the oracle ENUMERATES the whole
+# language with re.fullmatch and walks the prefix closure
+FINITE_PATTERNS = [
+    ("[ab]{3}", "ab", 3),
+    ("(a|bc)d", "abcd", 3),
+    ("a?b?c?", "abc", 3),
+    ("(ab|ba){1,2}", "ab", 4),
+    ("[a-c]{1,3}", "abcd", 3),
+    ("aa|ab|b", "ab", 2),
+]
+
+
+def _language(pattern: str, alphabet: str, max_len: int) -> set[bytes]:
+    lang = set()
+    rx = re.compile(pattern)
+    for n in range(max_len + 1):
+        for tup in itertools.product(alphabet, repeat=n):
+            s = "".join(tup)
+            if rx.fullmatch(s):
+                lang.add(s.encode())
+    return lang
+
+
+def _prefixes(lang: set[bytes]) -> set[bytes]:
+    out = set()
+    for s in lang:
+        for i in range(len(s) + 1):
+            out.add(s[:i])
+    return out
+
+
+def _oracle_check(aut, lang: set[bytes], alphabet: str):
+    """Walk every prefix of the language through the automaton and compare
+    its mask against the enumerated ground truth: byte b is allowed at
+    prefix p iff p+b is still a prefix of some word, EOS iff p is a word."""
+    assert lang, "vacuous oracle: empty language"
+    prefixes = _prefixes(lang)
+    probe = sorted({ord(c) for c in alphabet} | {0x7A, 0x30})  # + 'z','0'
+    for p in sorted(prefixes):
+        st = 0
+        for b in p:
+            st = aut.advance(st, b)
+            assert st >= 0, f"automaton rejects live prefix {p!r} at {b}"
+        mask = aut.mask_bool(st)
+        for b in probe:
+            want = p + bytes([b]) in prefixes
+            assert bool(mask[b]) == want, \
+                f"prefix {p!r}: byte {b:#x} allowed={bool(mask[b])} want={want}"
+        assert bool(mask[EOS]) == (p in lang), \
+            f"prefix {p!r}: EOS allowed={bool(mask[EOS])} want={p in lang}"
+        # packed-bitmask row agrees with the delta row it was packed from
+        vi = np.arange(aut.vocab_size)
+        unpacked = (aut.mask[st][vi >> 5] >> (vi & 31)) & 1
+        np.testing.assert_array_equal(unpacked.astype(bool), mask)
+
+
+@pytest.mark.parametrize("pattern,alphabet,max_len", FINITE_PATTERNS)
+def test_regex_mask_matches_bruteforce_oracle(pattern, alphabet, max_len):
+    aut, _ = compile_grammar("regex", pattern, VOCAB, eos_id=EOS)
+    _oracle_check(aut, _language(pattern, alphabet, max_len), alphabet)
+
+
+def test_random_regexes_match_oracle():
+    """Seeded random finite regexes (literals, classes, bounded reps,
+    alternation) against the same enumeration oracle."""
+    rng = np.random.default_rng(17)
+    for _ in range(12):
+        parts = []
+        for _ in range(int(rng.integers(1, 4))):
+            kind = int(rng.integers(0, 3))
+            if kind == 0:
+                parts.append("".join(rng.choice(list("abc"),
+                                                int(rng.integers(1, 3)))))
+            elif kind == 1:
+                parts.append("[ab]{%d}" % int(rng.integers(1, 3)))
+            else:
+                parts.append("(a|b)" + ("?" if rng.integers(0, 2) else ""))
+        pattern = "".join(parts)
+        aut, _ = compile_grammar("regex", pattern, VOCAB, eos_id=EOS)
+        lang = _language(pattern, "abc", 7)
+        _oracle_check(aut, lang, "abc")
+
+
+def test_schema_automaton_language_exact():
+    """The enum/bool schema's language is EXACTLY its four canonical
+    serializations — nothing else up to the longest word's length."""
+    schema = {"type": "object", "properties": {
+        "name": {"enum": ["alpha", "beta"]},
+        "ok": {"type": "boolean"}}}
+    aut, _ = compile_grammar("json_schema", schema, VOCAB, eos_id=EOS)
+    words = {b'{"name":"%s","ok":%s}' % (n, o)
+             for n in (b"alpha", b"beta") for o in (b"true", b"false")}
+    for w in words:
+        ok, complete = aut.validate(list(w) + [EOS])
+        assert ok and complete, w
+    # exhaustive rejection up to max length over the words' own byte set:
+    # every accepted string must be one of the four words
+    prefixes = _prefixes(words)
+    frontier = [(0, b"")]
+    seen_words = set()
+    while frontier:
+        st, p = frontier.pop()
+        mask = aut.mask_bool(st)
+        if mask[EOS]:
+            seen_words.add(p)
+        for b in np.flatnonzero(mask):
+            if b == EOS:
+                continue
+            q = p + bytes([int(b)])
+            assert q in prefixes, f"automaton admits rogue prefix {q!r}"
+            frontier.append((aut.advance(st, int(b)), q))
+    assert seen_words == words
+
+
+def test_ebnf_and_cache_and_errors():
+    aut, gh = compile_grammar("grammar", 'root ::= "a" "b" | "c"', VOCAB,
+                              eos_id=EOS)
+    assert aut.validate(list(b"ab") + [EOS]) == (True, True)
+    assert aut.validate(list(b"c") + [EOS]) == (True, True)
+    assert aut.validate(list(b"x"))[0] is False
+    # LRU cache: the same grammar compiles once
+    h0 = compile_stats()["hits"]
+    aut2, gh2 = compile_grammar("grammar", 'root ::= "a" "b" | "c"', VOCAB,
+                                eos_id=EOS)
+    assert gh2 == gh and aut2 is aut
+    assert compile_stats()["hits"] == h0 + 1
+    assert grammar_hash("grammar", 'root ::= "a" "b" | "c"') == gh
+    with pytest.raises(CompileError):
+        compile_grammar("regex", "[unclosed", VOCAB, eos_id=EOS)
+    with pytest.raises(CompileError):
+        compile_grammar("json_schema", {"type": "float64"}, VOCAB, eos_id=EOS)
+
+
+def test_forced_chain_is_the_singleton_spine():
+    """forced_chain walks exactly the singleton-mask states — every drafted
+    token is the ONLY allowed token at its state (guaranteed accept)."""
+    aut, _ = compile_grammar("regex", "abc(x|y)", VOCAB, eos_id=EOS)
+    chain = aut.forced_chain(0, 8)
+    assert bytes(chain) == b"abc"  # stops at the branch
+    st = 0
+    for t in chain:
+        mask = aut.mask_bool(st)
+        assert int(mask.sum()) == 1 and mask[t]
+        st = aut.advance(st, t)
+
+
+# ----------------------------------------------------------------------
+# engine: masked decode/verify identity + validity
+# ----------------------------------------------------------------------
+
+K = 8
+
+
+def _spec():
+    return ModelSpec(arch_type=ArchType.LLAMA, dim=64, hidden_dim=128,
+                     n_layers=2, n_heads=4, n_kv_heads=4, vocab_size=256,
+                     seq_len=256, rope_type=RopeType.LLAMA).resolved()
+
+
+def _greedy(spec):
+    return Sampler(spec.vocab_size, temperature=0.0)
+
+
+def _stoch(spec, seed=7):
+    return Sampler(spec.vocab_size, temperature=0.8, topp=0.9, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    spec = _spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4, speculative=K)
+    yield spec, params, be
+    be.close()
+
+
+def _grammar():
+    schema = {"type": "object", "properties": {
+        "name": {"enum": ["alpha", "beta"]},
+        "ok": {"type": "boolean"}}}
+    return compile_grammar("json_schema", schema, VOCAB, eos_id=EOS)
+
+
+def _branchy():
+    # no singleton-mask states after position 0 -> the GrammarProposer
+    # never drafts, so constrained rows ride the masked SCAN path
+    return compile_grammar("regex", "[a-z]{24}", VOCAB, eos_id=EOS)
+
+
+def _valid(aut, out):
+    """Grammar-validity of an engine output: the stream up to the first
+    EOS must be accepted; EOS then repeats (the done-state self-loop)."""
+    if EOS in out:
+        i = out.index(EOS)
+        assert set(out[i:]) == {EOS}, "post-EOS tokens escaped the mask"
+        ok, complete = aut.validate(out[: i + 1])
+        assert ok and complete, bytes(out[:i])
+    else:
+        ok, _ = aut.validate(out)
+        assert ok, bytes(out)
+
+
+def test_greedy_constrained_valid_and_minimal_intervention(setup):
+    """Constrained greedy output is grammar-valid, and agrees with the
+    unconstrained stream up to the FIRST position where the grammar
+    actually vetoed the unconstrained argmax — masking never rewrites a
+    token the grammar permits."""
+    spec, _, be = setup
+    aut, gh = _grammar()
+    prompt = [1, 5, 9]
+    plain = be.submit(list(prompt), 28, _greedy(spec)).wait(timeout=300)
+    cons = be.submit(list(prompt), 28, _greedy(spec), constraint=aut,
+                     constraint_hash=gh).wait(timeout=300)
+    _valid(aut, cons)
+    st = 0
+    for i, (c, u) in enumerate(zip(cons, plain)):
+        if c != u:
+            assert not aut.allows(st, u), (
+                f"step {i}: grammar permits unconstrained token {u} "
+                f"but masking replaced it with {c}")
+            break
+        st = aut.advance(st, c)
+        if c == EOS:
+            break
+
+
+def test_stochastic_constrained_valid_and_deterministic(setup):
+    spec, _, be = setup
+    aut, gh = _grammar()
+    prompt = [1, 5, 9]
+    outs = [be.submit(list(prompt), 28, _stoch(spec, seed=23),
+                      constraint=aut, constraint_hash=gh).wait(timeout=300)
+            for _ in range(2)]
+    _valid(aut, outs[0])
+    assert outs[0] == outs[1], "seeded constrained decode is not reproducible"
+
+
+def test_cobatched_rows_are_isolated(setup):
+    """One constrained + one unconstrained row in the same super-steps:
+    the unconstrained row is byte-identical to its solo run (a masked
+    program with the universal row-0 state is a no-op), and the
+    constrained row is byte-identical to ITS solo run."""
+    spec, _, be = setup
+    aut, gh = _grammar()
+    solo_plain = be.submit(list(REP), 24, _greedy(spec)).wait(timeout=300)
+    solo_cons = be.submit([1, 5, 9], 24, _greedy(spec), constraint=aut,
+                          constraint_hash=gh).wait(timeout=300)
+    rc = be.submit([1, 5, 9], 24, _greedy(spec), constraint=aut,
+                   constraint_hash=gh)
+    rp = be.submit(list(REP), 24, _greedy(spec))
+    assert rc.wait(timeout=300) == solo_cons
+    assert rp.wait(timeout=300) == solo_plain
+
+
+def _drafted(label: str) -> float:
+    from distributed_llama_tpu.obs import metrics
+    snap = metrics.REGISTRY.snapshot()
+    counts = snap.get("batch_spec_proposer_drafted_total", {})
+    if not isinstance(counts, dict):
+        return 0.0
+    return sum(v for k, v in counts.items() if label in k)
+
+
+def test_speculation_on_off_identity_with_grammar_proposer(setup):
+    """±GrammarProposer: speculation off vs on (grammar drafting forced
+    chains through the masked verify path) is byte-identical, greedy and
+    seeded-stochastic, and the grammar proposer actually drafted."""
+    spec, _, be = setup
+    aut, gh = _grammar()
+
+    def jobs():
+        # fresh samplers each run: the engine advances the host xorshift
+        # stream per delivered token, so a Sampler is single-use state
+        return [([1, 5, 9], _greedy(spec)), ([1, 5, 9], _stoch(spec, seed=31))]
+
+    k = be.spec_k
+    try:
+        be.spec_k = 0
+        off = [be.submit(list(p), 26, s, constraint=aut,
+                         constraint_hash=gh).wait(timeout=300)
+               for p, s in jobs()]
+    finally:
+        be.spec_k = k
+    d0 = _drafted("grammar")
+    on = [be.submit(list(p), 26, s, constraint=aut,
+                    constraint_hash=gh).wait(timeout=300)
+          for p, s in jobs()]
+    assert on == off, "grammar-proposed verify diverged from plain decode"
+    assert _drafted("grammar") > d0, \
+        "vacuous: the grammar proposer never drafted"
+    for out in on:
+        _valid(aut, out)
+
+
+def test_branchy_grammar_rides_masked_scan(setup):
+    """A grammar with no forced chains is served by the masked SCAN
+    program (GrammarProposer abstains); output is valid and deterministic,
+    and degrade never fired."""
+    spec, _, be = setup
+    aut, gh = _branchy()
+    deg0 = be.constrain_degraded
+    outs = [be.submit([1, 9], 30, _greedy(spec), constraint=aut,
+                      constraint_hash=gh).wait(timeout=300)
+            for _ in range(2)]
+    assert outs[0] == outs[1]
+    _valid(aut, outs[0])
+    assert be.constrain_degraded == deg0
+    stats = be.constrain_stats()
+    assert stats["active_rows"] == 0, "constraint table leaked a region"
+
+
+def test_grammar_too_large_is_an_honest_reject(setup):
+    """An automaton that cannot fit the constraint table is refused at
+    submit (client-visible InvalidRequest), never silently degraded."""
+    spec, params, _ = setup
+    aut, gh = _grammar()
+    be = BatchEngine(spec, params, slots=2, tp=1, superstep=4,
+                     constrain_states=4)
+    try:
+        with pytest.raises(InvalidRequest):
+            be.submit([1, 5, 9], 8, _greedy(spec), constraint=aut,
+                      constraint_hash=gh)
+        # the engine still serves unconstrained work afterwards
+        out = be.submit([1, 5, 9], 8, _greedy(spec)).wait(timeout=300)
+        assert len(out) == 8
+    finally:
+        be.close()
+
+
+# ----------------------------------------------------------------------
+# compile-manifest: masked buckets are pinned, rogues named
+# ----------------------------------------------------------------------
+
+def test_constrain_off_manifest_masked_bucket_fails_gate():
+    """ISSUE 17 satellite: the mask flag is part of the program cache key —
+    a masked verify T bucket outside the pinned set must fail the gate BY
+    NAME (mask=1 in the key), never alias onto the unmasked pin. The
+    factory call alone records the build (jit traces lazily)."""
+    from distributed_llama_tpu.analysis import compile_audit
+    from distributed_llama_tpu.parallel.mesh import make_mesh
+    from distributed_llama_tpu.runtime import device_loop
+
+    pinned = compile_audit.load_manifest()
+    assert pinned is not None, "perf/compile_manifest.json missing"
+    assert any(",mask=1]" in k for k in pinned["programs"]), \
+        "manifest lost its masked program pins"
+    spec = compile_audit.scenario_spec()
+    params = init_random_params(spec, FloatType.Q40, seed=11)
+    audit = compile_audit.CompileAudit()
+    with audit:
+        device_loop.make_batched_verify_loop(
+            spec, make_mesh(tp=1), params, 9, mode="greedy",
+            attn_window=None, kv_block_tokens=16, masked=True)
+    findings = compile_audit.diff_manifest(audit.manifest(), pinned)
+    assert findings, "gate missed the rogue masked T bucket"
+    key = "verify[t=9,mode=greedy,window=None,paged=16,mask=1]"
+    assert any(key in f.message for f in findings), \
+        [f.message for f in findings]
+    assert all(f.rule == "compile-manifest" for f in findings)

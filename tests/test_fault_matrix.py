@@ -27,7 +27,7 @@ def test_fault_matrix_no_scheduler_death_or_slot_leak():
         + fault_matrix.SUPERVISOR_CELLS + fault_matrix.DURABILITY_CELLS \
         + fault_matrix.FAIRNESS_CELLS + fault_matrix.DISAGG_CELLS \
         + fault_matrix.GRAY_CELLS + fault_matrix.DRAFT_CELLS \
-        + fault_matrix.FUSED_CELLS
+        + fault_matrix.FUSED_CELLS + fault_matrix.CONSTRAIN_CELLS
     assert cells == expected, (cells, expected)
     assert not problems, "\n".join(problems)
 
@@ -42,6 +42,7 @@ def test_matrix_covers_documented_inventory():
                   + fault_matrix.DISAGG_POINTS
                   + fault_matrix.DISAGG_PLAN_POINTS
                   + fault_matrix.DRAFT_POINTS
+                  + fault_matrix.CONSTRAIN_POINTS
                   + (fault_matrix.FUSED_POINT,))
     doc = open(os.path.join(os.path.dirname(__file__), "..", "docs",
                             "ROBUSTNESS.md")).read()
